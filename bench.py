@@ -1,0 +1,88 @@
+"""Benchmark: ResNet-50 ImageNet training throughput, single TPU chip.
+
+North-star metric (BASELINE.json): samples/sec/chip, ResNet-50, BS=256.
+Baseline (BASELINE.md): the reference's best published ResNet-50
+training number is 84.08 img/s (BS=256, 2x Xeon 6148 + MKL-DNN,
+benchmark/IntelOptimizedPaddle.md:38-45).  ``vs_baseline`` is the ratio
+of our samples/sec to that.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def build(batch, image, class_dim, dtype="float32"):
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet_imagenet
+
+    fluid.framework.reset_default_programs()
+    img = fluid.layers.data(name="img", shape=list(image), dtype=dtype)
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    pred = resnet_imagenet(img, class_dim=class_dim)
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(input=pred, label=label))
+    fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(loss)
+    return fluid, loss
+
+
+def run(batch=256, image=(3, 224, 224), class_dim=1000, steps=20, warmup=3):
+    import jax
+    from paddle_tpu import amp
+
+    if os.environ.get("BENCH_AMP", "1") == "1":
+        amp.enable()  # bf16 matmul/conv with fp32 master weights
+    fluid, loss = build(batch, image, class_dim)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(batch, *image).astype("float32")
+    ys = rng.randint(0, class_dim, (batch, 1)).astype("int64")
+    # Device-resident feed: on real hardware the input pipeline streams
+    # batches to HBM asynchronously; this harness's TPU sits behind a
+    # slow network tunnel, so we pre-stage one batch to measure the
+    # training step itself rather than tunnel bandwidth.
+    import jax.numpy as jnp
+
+    feed = {"img": jnp.asarray(xs), "label": jnp.asarray(ys)}
+
+    for _ in range(warmup):
+        (l,) = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
+    np.asarray(l)  # sync
+
+    # async dispatch: materialize the loss once at the end (a real loop
+    # logs every N steps; per-step host sync would measure tunnel RTT)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        (l,) = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
+    loss_val = float(np.asarray(l))  # sync
+    dt = time.perf_counter() - t0
+    return batch * steps / dt, loss_val
+
+
+def main():
+    baseline = 84.08  # img/s, reference ResNet-50 BS=256 train (see header)
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    try:
+        ips, loss_val = run(batch=batch, steps=steps)
+    except Exception as e:  # OOM etc: retry with half batch
+        print(f"bench: batch={batch} failed ({type(e).__name__}); retrying 128",
+              file=sys.stderr)
+        batch = 128
+        ips, loss_val = run(batch=batch, steps=steps)
+    print(json.dumps({
+        "metric": f"resnet50_train_samples_per_sec_per_chip_bs{batch}",
+        "value": round(ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(ips / baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+A ground-up rebuild of the capabilities of PaddlePaddle v0.11.0
+(reference: /root/reference) designed for TPU hardware:
+
+- **Program-as-data IR** (Program/Block/Operator/Variable), mirroring the
+  semantics of the reference's fluid ``framework.proto`` / ``framework.py``
+  (reference: python/paddle/v2/fluid/framework.py), but *lowered* rather
+  than interpreted: the Executor traces whole blocks into XLA programs via
+  JAX and caches compiled executables keyed by (block, feed shapes).
+- **Ops as lowering rules**: every op registers a JAX lowering (and
+  optionally a Pallas kernel) instead of per-place OpKernels
+  (reference: paddle/framework/op_registry.h).
+- **Autodiff on the IR**: ``append_backward`` inserts ``*_grad`` ops into
+  the program (reference: paddle/framework/backward.cc); grad lowerings
+  are derived from forward lowerings with ``jax.vjp`` unless a hand
+  written rule is provided.
+- **SPMD parallelism**: device meshes + shardings (``paddle_tpu.parallel``)
+  replace the reference's NCCL ops / parameter server with XLA
+  collectives over ICI.
+"""
+
+from paddle_tpu import framework
+from paddle_tpu.framework import (
+    Program,
+    Block,
+    Operator,
+    Variable,
+    Parameter,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+    CPUPlace,
+    TPUPlace,
+)
+from paddle_tpu.executor import Executor, global_scope, scope_guard, Scope
+from paddle_tpu.backward import append_backward
+from paddle_tpu import ops  # registers the op library
+from paddle_tpu import layers
+from paddle_tpu import nets
+from paddle_tpu import initializer
+from paddle_tpu import optimizer
+from paddle_tpu import regularizer
+from paddle_tpu import io
+from paddle_tpu import evaluator
+from paddle_tpu import profiler
+from paddle_tpu.data_feeder import DataFeeder
+from paddle_tpu.lod import LoDArray, create_lod_array
+from paddle_tpu import parallel
+
+__version__ = "0.1.0"

@@ -1,0 +1,63 @@
+"""Automatic mixed precision.
+
+The reference era trained fp32 with an experimental fp16 path
+(reference: paddle/math/float16.h, doc/design/float16.md).  On TPU the
+native fast path is bfloat16 on the MXU with fp32 accumulation — no
+loss scaling needed thanks to bf16's fp32-range exponent.  When
+enabled, matmul/conv lowerings cast operands to bf16 and keep bf16
+activations (halving HBM traffic); parameters, optimizer state and
+gradients stay fp32 (master weights), because the cast's vjp restores
+fp32 cotangents automatically.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+_STATE = {"enabled": False}
+
+
+def enable(flag: bool = True):
+    _STATE["enabled"] = bool(flag)
+
+
+def is_enabled() -> bool:
+    return _STATE["enabled"]
+
+
+def compute_dtype():
+    """bf16 when AMP is on, else None (keep operand dtype)."""
+    return jnp.bfloat16 if _STATE["enabled"] else None
+
+
+@contextlib.contextmanager
+def amp_guard(flag: bool = True):
+    old = _STATE["enabled"]
+    _STATE["enabled"] = bool(flag)
+    try:
+        yield
+    finally:
+        _STATE["enabled"] = old
+
+
+def cast_operands(*xs):
+    dt = compute_dtype()
+    if dt is None:
+        return xs
+    return tuple(x.astype(dt) if x.dtype in (jnp.float32, jnp.float64) else x
+                 for x in xs)
+
+
+def out_dtype(x):
+    """Output dtype for a matmul/conv given input x (pre-cast)."""
+    dt = compute_dtype()
+    return dt if dt is not None and x.dtype in (jnp.float32, jnp.bfloat16) else x.dtype
+
+
+def preferred_acc():
+    """preferred_element_type for dot/conv.  None under AMP: bf16 in/out
+    (MXU still accumulates fp32 internally); explicitly f32 otherwise.
+    Keeping in/out dtypes uniform keeps jax's conv transpose rule happy."""
+    return None if is_enabled() else jnp.float32

@@ -1,0 +1,139 @@
+"""Generic gradient lowering via ``jax.vjp``.
+
+A ``<type>_grad`` op emitted by ``append_backward`` carries its forward
+op's desc in attrs.  If the forward op registered no explicit
+``grad_lower``, this module synthesizes one: rebuild the forward
+computation from the traced scope values, ``jax.vjp`` it with respect to
+the inputs that need gradients, and pull the cotangents through.  The
+replayed forward lives in the same jit trace as the original, so XLA's
+CSE removes the duplication — the net effect is exactly the fused
+forward+backward program a hand-written grad kernel would produce.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.lod import LoDArray
+from paddle_tpu.registry import LowerContext, OpInfo, OpRegistry
+
+
+class _OpProxy:
+    """Operator-shaped view used to replay a forward lowering."""
+
+    __slots__ = ("type", "inputs", "outputs", "attrs", "block")
+
+    def __init__(self, type, inputs, outputs, attrs, block):
+        self.type = type
+        self.inputs = inputs
+        self.outputs = outputs
+        self.attrs = attrs
+        self.block = block
+
+    def input(self, slot):
+        return self.inputs.get(slot, [])
+
+    def output(self, slot):
+        return self.outputs.get(slot, [])
+
+    def attr(self, name, default=None):
+        return self.attrs.get(name, default)
+
+    @property
+    def input_arg_names(self):
+        return [n for ns in self.inputs.values() for n in ns]
+
+    @property
+    def output_arg_names(self):
+        return [n for ns in self.outputs.values() for n in ns]
+
+
+def _zeros_like_value(v):
+    if isinstance(v, LoDArray):
+        return LoDArray(jnp.zeros_like(v.data), v.lod)
+    return jnp.zeros_like(v)
+
+
+def generic_grad_lower(ctx: LowerContext):
+    gop = ctx.op
+    fwd_type = gop.attr("__fwd_type__")
+    fwd_inputs: Dict[str, List[str]] = gop.attr("__fwd_inputs__")
+    fwd_outputs: Dict[str, List[str]] = gop.attr("__fwd_outputs__")
+    fwd_attrs: Dict[str, Any] = gop.attr("__fwd_attrs__")
+    base = OpRegistry.get(fwd_type)
+
+    # Leaf inputs that need grads: (slot, index, fwd_name, grad_name).
+    targets = []
+    for gslot, gnames in gop.outputs.items():
+        slot = gslot[: -len("@GRAD")]
+        fnames = fwd_inputs.get(slot, [])
+        for i, gn in enumerate(gnames):
+            if gn:
+                targets.append((slot, i, fnames[i], gn))
+    if not targets:
+        return
+
+    primals = tuple(ctx.values[fn] for (_, _, fn, _) in targets)
+
+    # Only outputs the forward lowering actually wrote (optional outputs
+    # like sequence_pool's MaxIndex may be absent from the scope).
+    out_names = [
+        (slot, i, n)
+        for slot in sorted(fwd_outputs)
+        for i, n in enumerate(fwd_outputs[slot])
+        if n in ctx.values
+    ]
+
+    def replay(*prims):
+        local = {}
+        for names in fwd_inputs.values():
+            for n in names:
+                if n:
+                    local[n] = ctx.values[n]
+        for (slot, i, fn, _), p in zip(targets, prims):
+            local[fn] = p
+        proxy = _OpProxy(fwd_type, fwd_inputs, fwd_outputs, fwd_attrs, gop.block)
+        base.lower(LowerContext(proxy, local, rng=None, executor_ctx=ctx.executor_ctx))
+        return tuple(local[n] for (_, _, n) in out_names)
+
+    _, vjp_fn = jax.vjp(replay, *primals)
+
+    cts = []
+    for slot, i, n in out_names:
+        gnames = gop.inputs.get(slot + "@GRAD", [])
+        gname = gnames[i] if i < len(gnames) else ""
+        g = ctx.values.get(gname) if gname else None
+        if g is None:
+            g = _zeros_like_value(ctx.values[n])
+        cts.append(g)
+
+    grads = vjp_fn(tuple(cts))
+    for (slot, i, fn, gn), g in zip(targets, grads):
+        ctx.values[gn] = _strip_float0(g, ctx.values[fn])
+
+
+def _strip_float0(g, primal):
+    """Replace float0 cotangents (int primals) with zeros of primal dtype."""
+    import jax.dtypes
+
+    def fix(leaf, p):
+        if hasattr(leaf, "dtype") and leaf.dtype == jax.dtypes.float0:
+            return jnp.zeros(jnp.shape(p), jnp.result_type(float))
+        return leaf
+
+    if isinstance(g, LoDArray):
+        return LoDArray(fix(g.data, primal.data), primal.lod)
+    return fix(g, primal)
+
+
+def synthesize_grad_info(grad_type: str) -> OpInfo:
+    """Build (and register) an OpInfo for ``<base>_grad`` on demand."""
+    base_type = grad_type[: -len("_grad")]
+    base = OpRegistry.get(base_type)
+    lower = base.grad_lower if base.grad_lower is not None else generic_grad_lower
+    info = OpInfo(type=grad_type, lower=lower, stop_gradient=True)
+    OpRegistry._ops[grad_type] = info
+    return info

@@ -1,0 +1,236 @@
+"""IR-level autodiff: ``append_backward``.
+
+Mirrors the reference's desc-level backward pass
+(reference: paddle/framework/backward.cc:246,526 AppendBackward;
+python/paddle/v2/fluid/backward.py append_backward_ops): walk the block
+in reverse, emit one ``<type>_grad`` op per relevant forward op, dedup
+shared gradients by inserting ``sum`` ops, and return (param, grad)
+pairs for the optimizer.
+
+Grad ops carry their forward op's full desc in attrs; unless an op
+registered an explicit ``grad_lower``, the grad op lowers by applying
+``jax.vjp`` to the forward lowering rule — inside the same XLA trace as
+the forward pass, so replayed subexpressions CSE away.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from paddle_tpu import framework
+from paddle_tpu.framework import (
+    Block,
+    Operator,
+    Parameter,
+    Variable,
+    grad_var_name,
+    is_float_dtype,
+    unique_name,
+)
+from paddle_tpu.registry import OpRegistry
+
+_FWD_DESC_ATTRS = ("__fwd_type__", "__fwd_inputs__", "__fwd_outputs__", "__fwd_attrs__")
+
+
+def _ensure_grad_var(block: Block, fwd_name: str, grad_name: str) -> Variable:
+    if block.has_var(grad_name):
+        return block.var(grad_name)
+    fwd = block.find_var(fwd_name)
+    return block.create_var(
+        name=grad_name,
+        shape=fwd.shape if fwd is not None else None,
+        dtype=fwd.dtype if fwd is not None else "float32",
+        lod_level=fwd.lod_level if fwd is not None else 0,
+        stop_gradient=True,
+    )
+
+
+def _wants_grad(block: Block, name: str, no_grad_set: Set[str]) -> bool:
+    if not name or name in no_grad_set:
+        return False
+    var = block.find_var(name)
+    if var is None:
+        return False
+    if var.stop_gradient:
+        return False
+    return is_float_dtype(var.dtype)
+
+
+def _make_grad_op_desc(
+    op: Operator, block: Block, no_grad_set: Set[str]
+) -> Optional[Tuple[str, Dict, Dict, Dict]]:
+    """Default grad-op maker (reference: GradOpDescMakerBase,
+    framework/grad_op_desc_maker.h:170)."""
+    info = OpRegistry.get(op.type)
+    if info.stop_gradient:
+        return None
+    if info.grad_maker is not None:
+        return info.grad_maker(op, block, no_grad_set)
+
+    inputs: Dict[str, List[str]] = {}
+    for slot, names in op.inputs.items():
+        inputs[slot] = list(names)
+    for slot, names in op.outputs.items():
+        inputs[slot] = list(names)
+        inputs[slot + "@GRAD"] = [grad_var_name(n) for n in names]
+
+    outputs: Dict[str, List[str]] = {}
+    any_grad = False
+    for slot, names in op.inputs.items():
+        if info.diff_inputs is not None and slot not in info.diff_inputs:
+            continue
+        gnames = []
+        for n in names:
+            if _wants_grad(block, n, no_grad_set):
+                gnames.append(grad_var_name(n))
+                any_grad = True
+            else:
+                gnames.append("")
+        outputs[slot + "@GRAD"] = gnames
+    if not any_grad:
+        return None
+
+    attrs = {
+        "__fwd_type__": op.type,
+        "__fwd_inputs__": {k: list(v) for k, v in op.inputs.items()},
+        "__fwd_outputs__": {k: list(v) for k, v in op.outputs.items()},
+        "__fwd_attrs__": dict(op.attrs),
+    }
+    return (op.type + "_grad", inputs, outputs, attrs)
+
+
+def append_backward(
+    loss: Variable,
+    parameter_list: Optional[Sequence[str]] = None,
+    no_grad_set: Optional[Set[str]] = None,
+) -> List[Tuple[Parameter, Variable]]:
+    """Append gradient ops for ``loss`` to its program's global block and
+    return (parameter, gradient) pairs.
+
+    Reference: fluid/optimizer.py ``minimize`` → backward.py
+    ``append_backward_ops`` → framework/backward.cc ``AppendBackward``.
+    """
+    program = loss.block.program
+    block = program.global_block()
+    no_grad = set(no_grad_set or ())
+
+    # Backward slice: which vars influence the loss.
+    relevant: Set[str] = {loss.name}
+    relevant_ops: List[Operator] = []
+    for op in reversed(block.ops):
+        if OpRegistry.get(op.type, none_ok=True) is None:
+            continue
+        if relevant & set(op.output_arg_names):
+            relevant_ops.append(op)  # already reverse order
+            relevant |= set(op.input_arg_names)
+
+    # Seed d(loss)/d(loss) = 1.
+    loss_grad = _ensure_grad_var(block, loss.name, grad_var_name(loss.name))
+    block.append_op(
+        type="fill_constant",
+        outputs={"Out": [loss_grad.name]},
+        attrs={
+            "shape": list(loss.shape or ()),
+            "value": 1.0,
+            "dtype": loss.dtype,
+        },
+    )
+
+    # pending[fwd_name] = list of grad var names contributed by consumers.
+    pending: Dict[str, List[str]] = {loss.name: [loss_grad.name]}
+
+    def _settle(name: str) -> Optional[str]:
+        """Materialize the final (summed) gradient for forward var `name`
+        as grad_var_name(name); returns None if no contribution exists."""
+        contribs = pending.get(name, [])
+        target = grad_var_name(name)
+        if not contribs:
+            return None
+        if len(contribs) == 1:
+            src = contribs[0]
+            if src != target:
+                _ensure_grad_var(block, name, target)
+                block.append_op(
+                    type="assign", inputs={"X": [src]}, outputs={"Out": [target]}
+                )
+            pending[name] = [target]
+            return target
+        # Shared var: sum the contributions (reference: backward.cc
+        # inserts `sum` for deduped @GRAD@RENAME vars).
+        _ensure_grad_var(block, name, target)
+        block.append_op(type="sum", inputs={"X": contribs}, outputs={"Out": [target]})
+        pending[name] = [target]
+        return target
+
+    def _contribute(name: str, grad_name: str):
+        pending.setdefault(name, []).append(grad_name)
+
+    for op in relevant_ops:
+        desc = _make_grad_op_desc(op, block, no_grad)
+        if desc is None:
+            continue
+        gtype, ginputs, goutputs, gattrs = desc
+
+        # Settle incoming output-grads; prune slots with no contribution.
+        have_any_outgrad = False
+        for slot, names in list(op.outputs.items()):
+            gslot = slot + "@GRAD"
+            if gslot not in ginputs:
+                continue
+            settled = []
+            for n in names:
+                g = _settle(n)
+                settled.append(g if g is not None else "")
+                if g is not None:
+                    have_any_outgrad = True
+            ginputs[gslot] = settled
+        if not have_any_outgrad:
+            continue
+
+        # Unique-ify grad outputs that already have pending contributions
+        # (var consumed by several ops → rename + later sum).
+        for slot, gnames in goutputs.items():
+            fwd_slot = slot[: -len("@GRAD")]
+            fwd_names = ginputs.get(fwd_slot, [])
+            fixed = []
+            for i, gn in enumerate(gnames):
+                if not gn:
+                    fixed.append("")
+                    continue
+                fwd_n = fwd_names[i] if i < len(fwd_names) else None
+                if fwd_n is not None and pending.get(fwd_n):
+                    gn2 = unique_name(gn + "@RENAME")
+                    _ensure_grad_var(block, fwd_n, gn2)
+                    fixed.append(gn2)
+                    _contribute(fwd_n, gn2)
+                else:
+                    _ensure_grad_var(block, fwd_n, gn) if fwd_n else None
+                    fixed.append(gn)
+                    if fwd_n is not None:
+                        _contribute(fwd_n, gn)
+            goutputs[slot] = fixed
+
+        block.append_op(type=gtype, inputs=ginputs, outputs=goutputs, attrs=gattrs)
+
+    # Settle parameter gradients.
+    params: List[Parameter]
+    if parameter_list is not None:
+        params = [block.var(p) if isinstance(p, str) else p for p in parameter_list]
+    else:
+        params = [p for p in block.all_parameters() if p.trainable]
+
+    result: List[Tuple[Parameter, Variable]] = []
+    for p in params:
+        if p.name in no_grad:
+            continue
+        g = _settle(p.name)
+        if g is None:
+            continue
+        gvar = block.var(g)
+        # regularization: grad += coef * param appended here, like the
+        # reference appends regularizer ops (fluid/regularizer.py)
+        if getattr(p, "regularizer", None) is not None:
+            g = p.regularizer.append_regularization_op(p, gvar, block)
+            gvar = block.var(g) if isinstance(g, str) else g
+        result.append((p, gvar))
+    return result

@@ -1,0 +1,53 @@
+"""Stateful evaluators (reference: python/paddle/v2/fluid/evaluator.py —
+Accuracy/ChunkEvaluator as state-accumulating sub-programs).  Here the
+state lives host-side: metrics ops run in-graph per batch and the
+evaluator accumulates numpy scalars between ``reset``s."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu import layers
+from paddle_tpu.layer_helper import LayerHelper
+
+
+class Evaluator:
+    def reset(self):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class Accuracy(Evaluator):
+    """accuracy = accumulated correct / accumulated total."""
+
+    def __init__(self, input, label, k: int = 1, **kwargs):
+        helper = LayerHelper("accuracy_eval", **kwargs)
+        vals, idx = layers.topk(input, k=k)
+        self._acc = helper.create_tmp_variable("float32", (1,))
+        self._correct = helper.create_tmp_variable("int32", ())
+        self._total = helper.create_tmp_variable("int32", ())
+        helper.append_op(
+            type="accuracy",
+            inputs={"Out": [vals], "Indices": [idx], "Label": [label]},
+            outputs={"Accuracy": [self._acc], "Correct": [self._correct],
+                     "Total": [self._total]},
+        )
+        self.reset()
+
+    @property
+    def metrics(self):
+        """Fetch targets to pass to executor.run."""
+        return [self._acc, self._correct, self._total]
+
+    def update(self, correct, total):
+        self._c += int(np.asarray(correct))
+        self._t += int(np.asarray(total))
+
+    def reset(self, executor=None):
+        self._c = 0
+        self._t = 0
+
+    def eval(self, executor=None):
+        return self._c / max(self._t, 1)

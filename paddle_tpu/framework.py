@@ -1,0 +1,504 @@
+"""Program-as-data IR.
+
+Rebuilds the semantics of the reference's fluid graph representation
+(reference: python/paddle/v2/fluid/framework.py — ``Program:711``,
+``Block:567``, ``Operator:310``, ``Variable:93``; and the protobuf
+schema paddle/framework/framework.proto:33-145) as native Python
+dataclass-style objects.  Unlike the reference there is no C++
+``ProgramDesc`` mirror: the Python IR *is* the program, and the
+Executor lowers it straight to XLA via JAX tracing.  A protobuf-free
+``to_dict``/``from_dict`` serialization replaces the proto wire format.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import copy
+import hashlib
+import json
+import re
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Places (reference: paddle/platform/place.h:24-98).  On TPU there is no
+# per-op placement decision — a Place selects which jax backend the
+# Executor compiles for.
+# ---------------------------------------------------------------------------
+
+
+class Place:
+    _backend = None
+
+    def __repr__(self):
+        return type(self).__name__ + "()"
+
+    def __eq__(self, other):
+        return type(self) is type(other)
+
+    def __hash__(self):
+        return hash(type(self).__name__)
+
+
+class CPUPlace(Place):
+    _backend = "cpu"
+
+
+class TPUPlace(Place):
+    """The accelerator place.  Maps to whatever accelerator backend jax
+    exposes (tpu in production; the 'axon' tunnel or cpu in tests)."""
+
+    _backend = None  # None = jax default backend
+
+
+# GPUPlace alias kept for API familiarity with the reference; it selects
+# the default accelerator just like TPUPlace.
+CUDAPlace = TPUPlace
+GPUPlace = TPUPlace
+
+
+# ---------------------------------------------------------------------------
+# Data types.  (reference: framework.proto DataType enum)
+# ---------------------------------------------------------------------------
+
+_DTYPE_CANON = {
+    "bool": "bool",
+    "int8": "int8",
+    "uint8": "uint8",
+    "int16": "int16",
+    "int32": "int32",
+    "int64": "int64",
+    "float16": "float16",
+    "bfloat16": "bfloat16",
+    "float32": "float32",
+    "float64": "float64",
+}
+
+
+def convert_dtype(dtype) -> str:
+    """Canonicalize a dtype spec (str / np.dtype / jnp dtype) to a string."""
+    if dtype is None:
+        return "float32"
+    if isinstance(dtype, str):
+        name = dtype
+    else:
+        name = np.dtype(dtype).name if not hasattr(dtype, "name") else dtype.name
+    name = {"float": "float32", "double": "float64", "int": "int32"}.get(name, name)
+    if name not in _DTYPE_CANON:
+        raise ValueError(f"unsupported dtype {dtype!r}")
+    return name
+
+
+def is_float_dtype(dtype: str) -> bool:
+    return convert_dtype(dtype) in ("float16", "bfloat16", "float32", "float64")
+
+
+# ---------------------------------------------------------------------------
+# Unique names (reference: fluid framework.py unique_name)
+# ---------------------------------------------------------------------------
+
+
+class _UniqueNameGenerator:
+    def __init__(self):
+        self.ids = collections.defaultdict(int)
+
+    def __call__(self, key: str) -> str:
+        tmp = self.ids[key]
+        self.ids[key] += 1
+        return f"{key}_{tmp}"
+
+
+_name_gen = _UniqueNameGenerator()
+
+
+def unique_name(key: str) -> str:
+    return _name_gen(key)
+
+
+GRAD_SUFFIX = "@GRAD"
+
+
+def grad_var_name(name: str) -> str:
+    return name + GRAD_SUFFIX
+
+
+# ---------------------------------------------------------------------------
+# Variable  (reference: fluid framework.py:93; framework/var_desc.h)
+# ---------------------------------------------------------------------------
+
+
+class VarType:
+    LOD_TENSOR = "lod_tensor"
+    SELECTED_ROWS = "selected_rows"
+    LOD_TENSOR_ARRAY = "lod_tensor_array"
+    STEP_SCOPES = "step_scopes"
+    RAW = "raw"
+
+
+class Variable:
+    def __init__(
+        self,
+        block: "Block",
+        name: Optional[str] = None,
+        shape: Optional[Sequence[int]] = None,
+        dtype="float32",
+        lod_level: int = 0,
+        persistable: bool = False,
+        stop_gradient: bool = False,
+        type: str = VarType.LOD_TENSOR,
+        initializer=None,
+    ):
+        self.block = block
+        self.name = name if name is not None else unique_name("_generated_var")
+        self.shape = tuple(int(s) for s in shape) if shape is not None else None
+        self.dtype = convert_dtype(dtype)
+        self.lod_level = lod_level
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.type = type
+        # set lazily by layers that want an init op appended to startup
+        self.initializer = initializer
+
+    # convenience mirroring the reference API
+    @property
+    def ndim(self):
+        return len(self.shape) if self.shape is not None else None
+
+    def __repr__(self):
+        return (
+            f"Variable(name={self.name!r}, shape={self.shape}, dtype={self.dtype},"
+            f" lod_level={self.lod_level}, persistable={self.persistable})"
+        )
+
+    def to_dict(self):
+        return {
+            "name": self.name,
+            "shape": list(self.shape) if self.shape is not None else None,
+            "dtype": self.dtype,
+            "lod_level": self.lod_level,
+            "persistable": self.persistable,
+            "stop_gradient": self.stop_gradient,
+            "type": self.type,
+            "is_parameter": isinstance(self, Parameter),
+            "trainable": getattr(self, "trainable", None),
+        }
+
+
+class Parameter(Variable):
+    """A trainable, persistable variable (reference: fluid framework.py
+    ``Parameter``; paddle/parameter/Parameter.h:60 in the legacy stack)."""
+
+    def __init__(self, block, shape, dtype, **kwargs):
+        self.trainable = kwargs.pop("trainable", True)
+        self.regularizer = kwargs.pop("regularizer", None)
+        self.gradient_clip = kwargs.pop("gradient_clip", None)
+        self.optimize_attr = kwargs.pop("optimize_attr", {"learning_rate": 1.0})
+        super().__init__(
+            block, shape=shape, dtype=dtype, persistable=True, **kwargs
+        )
+
+
+# ---------------------------------------------------------------------------
+# Operator  (reference: fluid framework.py:310; framework/op_desc.h)
+# ---------------------------------------------------------------------------
+
+
+def _as_name_list(v) -> List[str]:
+    if v is None:
+        return []
+    if isinstance(v, (list, tuple)):
+        return [x.name if isinstance(x, Variable) else str(x) for x in v]
+    return [v.name if isinstance(v, Variable) else str(v)]
+
+
+class Operator:
+    def __init__(
+        self,
+        block: "Block",
+        type: str,
+        inputs: Optional[Dict[str, Any]] = None,
+        outputs: Optional[Dict[str, Any]] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ):
+        self.block = block
+        self.type = type
+        self.inputs: Dict[str, List[str]] = {
+            k: _as_name_list(v) for k, v in (inputs or {}).items()
+        }
+        self.outputs: Dict[str, List[str]] = {
+            k: _as_name_list(v) for k, v in (outputs or {}).items()
+        }
+        self.attrs: Dict[str, Any] = dict(attrs or {})
+        # Run registry-side checks/infer-shape at append time, like the
+        # reference's compile-time InferShape (framework/op_desc.cc).
+        from paddle_tpu import registry
+
+        info = registry.OpRegistry.get(type, none_ok=True)
+        if info is not None and info.infer_shape is not None:
+            try:
+                info.infer_shape(self, block)
+            except registry.SkipInferShape:
+                pass
+
+    def input(self, slot: str) -> List[str]:
+        return self.inputs.get(slot, [])
+
+    def output(self, slot: str) -> List[str]:
+        return self.outputs.get(slot, [])
+
+    @property
+    def input_arg_names(self) -> List[str]:
+        return [n for ns in self.inputs.values() for n in ns]
+
+    @property
+    def output_arg_names(self) -> List[str]:
+        return [n for ns in self.outputs.values() for n in ns]
+
+    def attr(self, name: str, default=None):
+        return self.attrs.get(name, default)
+
+    def to_dict(self):
+        def _attr_ser(v):
+            if isinstance(v, Block):
+                return {"__block__": v.idx}
+            if isinstance(v, np.ndarray):
+                return {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
+            return v
+
+        return {
+            "type": self.type,
+            "inputs": self.inputs,
+            "outputs": self.outputs,
+            "attrs": {k: _attr_ser(v) for k, v in self.attrs.items()},
+        }
+
+    def __repr__(self):
+        ins = ", ".join(f"{k}={v}" for k, v in self.inputs.items())
+        outs = ", ".join(f"{k}={v}" for k, v in self.outputs.items())
+        return f"{{{self.type}: ({ins}) -> ({outs})}}"
+
+
+# ---------------------------------------------------------------------------
+# Block  (reference: fluid framework.py:567; framework/block_desc.h:37)
+# ---------------------------------------------------------------------------
+
+
+class Block:
+    def __init__(self, program: "Program", idx: int, parent_idx: int = -1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars: Dict[str, Variable] = collections.OrderedDict()
+        self.ops: List[Operator] = []
+
+    @property
+    def parent(self) -> Optional["Block"]:
+        if self.parent_idx < 0:
+            return None
+        return self.program.blocks[self.parent_idx]
+
+    # --- variables ---------------------------------------------------------
+
+    def create_var(self, **kwargs) -> Variable:
+        var = Variable(self, **kwargs)
+        self.vars[var.name] = var
+        return var
+
+    def create_parameter(self, shape, dtype, **kwargs) -> Parameter:
+        # parameters always live in the root block (reference:
+        # fluid framework.py global_block parameter placement)
+        global_block = self.program.blocks[0]
+        param = Parameter(global_block, shape, dtype, **kwargs)
+        global_block.vars[param.name] = param
+        return param
+
+    def var(self, name: str) -> Variable:
+        v = self.find_var(name)
+        if v is None:
+            raise KeyError(f"variable {name!r} not found in block {self.idx}")
+        return v
+
+    def find_var(self, name: str) -> Optional[Variable]:
+        """Parent-chain lookup (reference: framework/scope.h:38 FindVar)."""
+        b: Optional[Block] = self
+        while b is not None:
+            if name in b.vars:
+                return b.vars[name]
+            b = b.parent
+        return None
+
+    def has_var(self, name: str) -> bool:
+        return self.find_var(name) is not None
+
+    def all_parameters(self) -> List[Parameter]:
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    # --- ops ---------------------------------------------------------------
+
+    def append_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.append(op)
+        return op
+
+    def prepend_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.insert(0, op)
+        return op
+
+    def insert_op(self, index, type, inputs=None, outputs=None, attrs=None) -> Operator:
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.insert(index, op)
+        return op
+
+    def to_dict(self):
+        return {
+            "idx": self.idx,
+            "parent_idx": self.parent_idx,
+            "vars": {k: v.to_dict() for k, v in self.vars.items()},
+            "ops": [op.to_dict() for op in self.ops],
+        }
+
+
+# ---------------------------------------------------------------------------
+# Program  (reference: fluid framework.py:711; framework/program_desc.h)
+# ---------------------------------------------------------------------------
+
+
+class Program:
+    def __init__(self):
+        self.blocks: List[Block] = [Block(self, 0)]
+        self.current_block_idx = 0
+        self.seed: Optional[int] = None  # program-level RNG seed
+
+    # --- block management --------------------------------------------------
+
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def current_block(self) -> Block:
+        return self.blocks[self.current_block_idx]
+
+    def create_block(self, parent_idx: Optional[int] = None) -> Block:
+        parent = self.current_block_idx if parent_idx is None else parent_idx
+        b = Block(self, len(self.blocks), parent)
+        self.blocks.append(b)
+        self.current_block_idx = b.idx
+        return b
+
+    def rollback(self):
+        self.current_block_idx = self.current_block().parent_idx
+
+    def all_parameters(self) -> List[Parameter]:
+        return self.global_block().all_parameters()
+
+    # --- serialization / identity ------------------------------------------
+
+    def to_dict(self):
+        return {
+            "blocks": [b.to_dict() for b in self.blocks],
+            "seed": self.seed,
+        }
+
+    def to_string(self, throw_on_error: bool = False) -> str:
+        return json.dumps(self.to_dict(), indent=2, default=str)
+
+    __str__ = to_string
+
+    def fingerprint(self) -> str:
+        """Stable content hash; the compile-cache key component."""
+        blob = json.dumps(self.to_dict(), sort_keys=True, default=str)
+        return hashlib.sha1(blob.encode()).hexdigest()
+
+    def clone(self, for_test: bool = False) -> "Program":
+        """Deep-copy the program.  With ``for_test=True``, flips ops with an
+        ``is_test`` attribute (dropout, batch_norm) into inference mode
+        (reference: fluid framework.py Program.clone / inference_optimize)."""
+        p = copy.deepcopy(self)
+        # the content-hash cache must not survive the copy: the clone may
+        # differ only in op attrs (is_test), which the cheap op/var-count
+        # staleness check cannot see
+        p.invalidate_cache()
+        if for_test:
+            for block in p.blocks:
+                for op in block.ops:
+                    if "is_test" in _ops_with_is_test(op.type):
+                        op.attrs["is_test"] = True
+        return p
+
+    def invalidate_cache(self):
+        """Drop the cached fingerprint (call after mutating op attrs
+        in place; structural mutations are detected automatically)."""
+        if hasattr(self, "_fp_cache"):
+            del self._fp_cache
+
+    def prune(self, targets) -> "Program":
+        """Dead-op elimination given fetch targets (reference:
+        framework/prune.cc).  Keeps ops whose outputs (transitively) feed a
+        target; drops the rest."""
+        target_names = set(_as_name_list(targets))
+        p = self.clone()
+        block = p.global_block()
+        needed = set(target_names)
+        kept: List[Operator] = []
+        for op in reversed(block.ops):
+            if needed & set(op.output_arg_names) or op.type in ("feed",):
+                kept.append(op)
+                needed |= set(op.input_arg_names)
+        block.ops = list(reversed(kept))
+        return p
+
+
+def _ops_with_is_test(op_type: str):
+    return {"dropout": ("is_test",), "batch_norm": ("is_test",)}.get(op_type, ())
+
+
+# ---------------------------------------------------------------------------
+# Default programs + guards (reference: fluid framework.py:875-886)
+# ---------------------------------------------------------------------------
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+def switch_main_program(p: Program) -> Program:
+    global _main_program
+    old, _main_program = _main_program, p
+    return old
+
+
+def switch_startup_program(p: Program) -> Program:
+    global _startup_program
+    old, _startup_program = _startup_program, p
+    return old
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    old_main = switch_main_program(main_program)
+    old_startup = None
+    if startup_program is not None:
+        old_startup = switch_startup_program(startup_program)
+    try:
+        yield
+    finally:
+        switch_main_program(old_main)
+        if old_startup is not None:
+            switch_startup_program(old_startup)
+
+
+def reset_default_programs():
+    """Fresh default programs + name counter (used by tests)."""
+    global _main_program, _startup_program, _name_gen
+    _main_program = Program()
+    _startup_program = Program()
+    _name_gen.ids.clear()

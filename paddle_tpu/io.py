@@ -1,0 +1,150 @@
+"""Model persistence (reference: python/paddle/v2/fluid/io.py —
+save/load_persistables:81, save/load_inference_model:165-224; tensor
+serialization: operators/save_op.cc).
+
+Checkpoints are directories of ``.npz`` per-variable files plus a JSON
+manifest; ``save_inference_model`` stores the pruned program alongside.
+(A sharded TensorStore/orbax path is the scaling follow-up.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu import framework
+from paddle_tpu.executor import Executor, global_scope
+from paddle_tpu.framework import Parameter, Program, Variable
+
+_FORMAT_VERSION = 1
+
+
+def _is_persistable(var: Variable) -> bool:
+    return var.persistable
+
+
+def _is_parameter(var: Variable) -> bool:
+    return isinstance(var, Parameter)
+
+
+def save_vars(executor, dirname: str, main_program: Optional[Program] = None,
+              predicate=_is_persistable, vars=None):
+    main_program = main_program or framework.default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    scope = global_scope()
+    if vars is None:
+        vars = [v for v in main_program.global_block().vars.values() if predicate(v)]
+    manifest = {"format_version": _FORMAT_VERSION, "vars": {}}
+    for v in vars:
+        val = scope.get(v.name)
+        if val is None:
+            continue
+        arr = np.asarray(val)
+        np.save(os.path.join(dirname, v.name + ".npy"), arr, allow_pickle=False)
+        manifest["vars"][v.name] = {"shape": list(arr.shape), "dtype": str(arr.dtype)}
+    with open(os.path.join(dirname, "MANIFEST.json"), "w") as f:
+        json.dump(manifest, f, indent=1)
+
+
+def load_vars(executor, dirname: str, main_program: Optional[Program] = None,
+              predicate=_is_persistable, vars=None):
+    main_program = main_program or framework.default_main_program()
+    scope = global_scope()
+    if vars is None:
+        vars = [v for v in main_program.global_block().vars.values() if predicate(v)]
+    for v in vars:
+        path = os.path.join(dirname, v.name + ".npy")
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"no saved value for variable {v.name!r} in {dirname}")
+        scope.set(v.name, np.load(path))
+
+
+def save_params(executor, dirname, main_program=None):
+    save_vars(executor, dirname, main_program, predicate=_is_parameter)
+
+
+def load_params(executor, dirname, main_program=None):
+    load_vars(executor, dirname, main_program, predicate=_is_parameter)
+
+
+def save_persistables(executor, dirname, main_program=None):
+    save_vars(executor, dirname, main_program, predicate=_is_persistable)
+
+
+def load_persistables(executor, dirname, main_program=None):
+    load_vars(executor, dirname, main_program, predicate=_is_persistable)
+
+
+def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
+                         target_vars: Sequence[Variable], executor,
+                         main_program: Optional[Program] = None):
+    """Prune to the inference slice and save program + params
+    (reference: fluid/io.py:165 + framework/prune.cc)."""
+    main_program = main_program or framework.default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    inference_program = main_program.clone(for_test=True).prune(list(target_vars))
+    with open(os.path.join(dirname, "__model__.json"), "w") as f:
+        json.dump({
+            "program": inference_program.to_dict(),
+            "feed_names": list(feeded_var_names),
+            "fetch_names": [v.name if isinstance(v, Variable) else v for v in target_vars],
+        }, f, default=str)
+    save_params(executor, dirname, main_program)
+    return inference_program
+
+
+def load_inference_model(dirname: str, executor):
+    with open(os.path.join(dirname, "__model__.json")) as f:
+        meta = json.load(f)
+    program = _program_from_dict(meta["program"])
+    # load params into scope
+    scope = global_scope()
+    manifest_path = os.path.join(dirname, "MANIFEST.json")
+    if os.path.exists(manifest_path):
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        for name in manifest["vars"]:
+            scope.set(name, np.load(os.path.join(dirname, name + ".npy")))
+    return program, meta["feed_names"], meta["fetch_names"]
+
+
+def _program_from_dict(d) -> Program:
+    from paddle_tpu.framework import Block, Operator, Parameter, Variable
+
+    p = Program.__new__(Program)
+    p.blocks = []
+    p.current_block_idx = 0
+    p.seed = d.get("seed")
+    for bd in d["blocks"]:
+        b = Block(p, bd["idx"], bd["parent_idx"])
+        p.blocks.append(b)
+    for bd, b in zip(d["blocks"], p.blocks):
+        for name, vd in bd["vars"].items():
+            cls = Parameter if vd.get("is_parameter") else Variable
+            if cls is Parameter:
+                var = Parameter(b, vd["shape"], vd["dtype"], name=name)
+            else:
+                var = Variable(b, name=name, shape=vd["shape"], dtype=vd["dtype"],
+                               lod_level=vd.get("lod_level", 0),
+                               persistable=vd.get("persistable", False),
+                               stop_gradient=vd.get("stop_gradient", False))
+            b.vars[name] = var
+        for od in bd["ops"]:
+            attrs = {}
+            for k, v in od["attrs"].items():
+                if isinstance(v, dict) and "__block__" in v:
+                    v = p.blocks[v["__block__"]]
+                elif isinstance(v, dict) and "__ndarray__" in v:
+                    v = np.asarray(v["__ndarray__"], dtype=v["dtype"])
+                attrs[k] = v
+            op = Operator.__new__(Operator)
+            op.block = b
+            op.type = od["type"]
+            op.inputs = {k: list(v) for k, v in od["inputs"].items()}
+            op.outputs = {k: list(v) for k, v in od["outputs"].items()}
+            op.attrs = attrs
+            b.ops.append(op)
+    return p

@@ -1,0 +1,5 @@
+from paddle_tpu.layers.io import data
+from paddle_tpu.layers.nn import *  # noqa: F401,F403
+from paddle_tpu.layers.tensor import *  # noqa: F401,F403
+from paddle_tpu.layers.sequence import *  # noqa: F401,F403
+from paddle_tpu.layers.ops import *  # noqa: F401,F403

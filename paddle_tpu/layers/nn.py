@@ -1,0 +1,373 @@
+"""NN layers (reference: python/paddle/v2/fluid/layers/nn.py — fc:17,
+embedding:91, conv2d:471, plus pool2d/batch_norm/dropout and the loss
+wrappers)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from paddle_tpu.framework import Variable
+from paddle_tpu.initializer import ConstantInitializer, NormalInitializer
+from paddle_tpu.layer_helper import LayerHelper
+
+__all__ = [
+    "fc",
+    "embedding",
+    "conv2d",
+    "conv2d_transpose",
+    "pool2d",
+    "batch_norm",
+    "dropout",
+    "cross_entropy",
+    "softmax_with_cross_entropy",
+    "square_error_cost",
+    "accuracy",
+    "topk",
+    "lstm",
+    "dynamic_lstm",
+    "matmul",
+    "lrn",
+]
+
+
+def _to_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def fc(
+    input,
+    size: int,
+    num_flatten_dims: int = 1,
+    param_attr=None,
+    bias_attr=None,
+    act: Optional[str] = None,
+    name: Optional[str] = None,
+    **kwargs,
+):
+    helper = LayerHelper("fc", param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name, **kwargs)
+    dtype = _to_list(input)[0].dtype
+    mul_results = []
+    for inp in _to_list(input):
+        in_shape = inp.shape
+        lead = in_shape[num_flatten_dims:]
+        in_features = 1
+        for s in lead:
+            in_features *= s
+        w = helper.create_parameter(param_attr, shape=[in_features, size], dtype=dtype)
+        tmp = helper.create_tmp_variable(
+            dtype, tuple(in_shape[:num_flatten_dims]) + (size,), inp.lod_level
+        )
+        helper.append_op(
+            type="mul",
+            inputs={"X": [inp], "Y": [w]},
+            outputs={"Out": [tmp]},
+            attrs={"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1},
+        )
+        mul_results.append(tmp)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_tmp_variable(dtype, mul_results[0].shape,
+                                              mul_results[0].lod_level)
+        helper.append_op(type="sum", inputs={"X": mul_results},
+                         outputs={"Out": [pre_bias]})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims)
+    return helper.append_activation(pre_act)
+
+
+def embedding(input, size, is_sparse: bool = False, padding_idx=None,
+              param_attr=None, dtype="float32", **kwargs):
+    """size = [vocab, dim].  ``is_sparse`` is accepted for API parity; on
+    TPU the gradient is a dense XLA scatter-add either way."""
+    helper = LayerHelper("embedding", param_attr=param_attr, **kwargs)
+    w = helper.create_parameter(
+        param_attr, shape=list(size), dtype=dtype,
+        default_initializer=NormalInitializer(0.0, 0.02),
+    )
+    out = helper.create_tmp_variable(
+        dtype, tuple(input.shape[:-1]) + (size[1],), input.lod_level
+    )
+    helper.append_op(
+        type="lookup_table",
+        inputs={"W": [w], "Ids": [input]},
+        outputs={"Out": [out]},
+        attrs={"is_sparse": is_sparse, "padding_idx": padding_idx},
+    )
+    return out
+
+
+def _conv_out_size(size, k, p, s, d=1):
+    if size is None or size < 0:
+        return -1
+    ke = (k - 1) * d + 1
+    return (size + 2 * p - ke) // s + 1
+
+
+def conv2d(
+    input,
+    num_filters: int,
+    filter_size,
+    stride=1,
+    padding=0,
+    dilation=1,
+    groups: int = 1,
+    param_attr=None,
+    bias_attr=None,
+    act: Optional[str] = None,
+    name: Optional[str] = None,
+    **kwargs,
+):
+    helper = LayerHelper("conv2d", param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name, **kwargs)
+    dtype = input.dtype
+    fs = filter_size if isinstance(filter_size, (list, tuple)) else (filter_size, filter_size)
+    st = stride if isinstance(stride, (list, tuple)) else (stride, stride)
+    pd = padding if isinstance(padding, (list, tuple)) else (padding, padding)
+    dl = dilation if isinstance(dilation, (list, tuple)) else (dilation, dilation)
+    n, c, h, w = input.shape
+    filt = helper.create_parameter(
+        param_attr,
+        shape=[num_filters, c // groups, fs[0], fs[1]],
+        dtype=dtype,
+        default_initializer=NormalInitializer(
+            0.0, (2.0 / (fs[0] * fs[1] * (c // groups))) ** 0.5
+        ),
+    )
+    out_shape = (
+        n,
+        num_filters,
+        _conv_out_size(h, fs[0], pd[0], st[0], dl[0]),
+        _conv_out_size(w, fs[1], pd[1], st[1], dl[1]),
+    )
+    pre_bias = helper.create_tmp_variable(dtype, out_shape)
+    helper.append_op(
+        type="conv2d",
+        inputs={"Input": [input], "Filter": [filt]},
+        outputs={"Output": [pre_bias]},
+        attrs={"strides": list(st), "paddings": list(pd), "dilations": list(dl),
+               "groups": groups},
+    )
+    # per-channel bias, broadcast along axis=1 (N, C, H, W)
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def conv2d_transpose(input, num_filters, filter_size, stride=1, padding=0,
+                     param_attr=None, bias_attr=None, act=None, **kwargs):
+    helper = LayerHelper("conv2d_transpose", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, **kwargs)
+    dtype = input.dtype
+    fs = filter_size if isinstance(filter_size, (list, tuple)) else (filter_size, filter_size)
+    st = stride if isinstance(stride, (list, tuple)) else (stride, stride)
+    pd = padding if isinstance(padding, (list, tuple)) else (padding, padding)
+    n, c, h, w = input.shape
+    filt = helper.create_parameter(param_attr, shape=[c, num_filters, fs[0], fs[1]],
+                                   dtype=dtype)
+    oh = (h - 1) * st[0] - 2 * pd[0] + fs[0] if h and h > 0 else -1
+    ow = (w - 1) * st[1] - 2 * pd[1] + fs[1] if w and w > 0 else -1
+    pre_bias = helper.create_tmp_variable(dtype, (n, num_filters, oh, ow))
+    helper.append_op(
+        type="conv2d_transpose",
+        inputs={"Input": [input], "Filter": [filt]},
+        outputs={"Output": [pre_bias]},
+        attrs={"strides": list(st), "paddings": list(pd), "dilations": [1, 1]},
+    )
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def pool2d(input, pool_size=2, pool_type="max", pool_stride=1, pool_padding=0,
+           global_pooling: bool = False, exclusive: bool = False, name=None, **kwargs):
+    helper = LayerHelper("pool2d", name=name, **kwargs)
+    ks = pool_size if isinstance(pool_size, (list, tuple)) else (pool_size, pool_size)
+    st = pool_stride if isinstance(pool_stride, (list, tuple)) else (pool_stride, pool_stride)
+    pd = pool_padding if isinstance(pool_padding, (list, tuple)) else (pool_padding, pool_padding)
+    n, c, h, w = input.shape
+    if global_pooling:
+        out_shape = (n, c, 1, 1)
+    else:
+        out_shape = (
+            n, c,
+            _conv_out_size(h, ks[0], pd[0], st[0]),
+            _conv_out_size(w, ks[1], pd[1], st[1]),
+        )
+    out = helper.create_tmp_variable(input.dtype, out_shape)
+    helper.append_op(
+        type="pool2d",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"pooling_type": pool_type, "ksize": list(ks), "strides": list(st),
+               "paddings": list(pd), "global_pooling": global_pooling,
+               "exclusive": exclusive},
+    )
+    return out
+
+
+def batch_norm(input, act=None, is_test: bool = False, momentum: float = 0.9,
+               epsilon: float = 1e-5, param_attr=None, bias_attr=None,
+               data_layout="NCHW", name=None, moving_mean_name=None,
+               moving_variance_name=None, **kwargs):
+    helper = LayerHelper("batch_norm", act=act, name=name, **kwargs)
+    dtype = input.dtype
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale = helper.create_parameter(
+        param_attr, shape=[c], dtype=dtype,
+        default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(bias_attr, shape=[c], dtype=dtype, is_bias=True)
+    # running stats: persistable but not trainable
+    from paddle_tpu.param_attr import ParamAttr
+
+    mean = helper.create_parameter(
+        ParamAttr(name=moving_mean_name, initializer=ConstantInitializer(0.0),
+                  trainable=False),
+        shape=[c], dtype="float32")
+    variance = helper.create_parameter(
+        ParamAttr(name=moving_variance_name, initializer=ConstantInitializer(1.0),
+                  trainable=False),
+        shape=[c], dtype="float32")
+    saved_mean = helper.create_tmp_variable("float32", (c,))
+    saved_var = helper.create_tmp_variable("float32", (c,))
+    out = helper.create_tmp_variable(dtype, input.shape)
+    helper.append_op(
+        type="batch_norm",
+        inputs={"X": [input], "Scale": [scale], "Bias": [bias],
+                "Mean": [mean], "Variance": [variance]},
+        outputs={"Y": [out], "MeanOut": [mean], "VarianceOut": [variance],
+                 "SavedMean": [saved_mean], "SavedVariance": [saved_var]},
+        attrs={"momentum": momentum, "epsilon": epsilon, "is_test": is_test,
+               "data_layout": data_layout},
+    )
+    return helper.append_activation(out)
+
+
+def dropout(x, dropout_prob: float, is_test: bool = False, seed=None, name=None, **kwargs):
+    helper = LayerHelper("dropout", name=name, **kwargs)
+    out = helper.create_tmp_variable(x.dtype, x.shape, x.lod_level)
+    mask = helper.create_tmp_variable(x.dtype, x.shape)
+    helper.append_op(
+        type="dropout",
+        inputs={"X": [x]},
+        outputs={"Out": [out], "Mask": [mask]},
+        attrs={"dropout_prob": dropout_prob, "is_test": is_test},
+    )
+    return out
+
+
+def cross_entropy(input, label, soft_label: bool = False, **kwargs):
+    helper = LayerHelper("cross_entropy", **kwargs)
+    out = helper.create_tmp_variable(input.dtype,
+                                     tuple(input.shape[:-1]) + (1,),
+                                     input.lod_level)
+    helper.append_op(
+        type="cross_entropy",
+        inputs={"X": [input], "Label": [label]},
+        outputs={"Y": [out]},
+        attrs={"soft_label": soft_label},
+    )
+    return out
+
+
+def softmax_with_cross_entropy(logits, label, soft_label: bool = False, **kwargs):
+    helper = LayerHelper("softmax_with_cross_entropy", **kwargs)
+    softmax = helper.create_tmp_variable(logits.dtype, logits.shape)
+    loss = helper.create_tmp_variable(logits.dtype, tuple(logits.shape[:-1]) + (1,))
+    helper.append_op(
+        type="softmax_with_cross_entropy",
+        inputs={"Logits": [logits], "Label": [label]},
+        outputs={"Softmax": [softmax], "Loss": [loss]},
+        attrs={"soft_label": soft_label},
+    )
+    return loss
+
+
+def square_error_cost(input, label, **kwargs):
+    helper = LayerHelper("square_error_cost", **kwargs)
+    minus_out = helper.create_tmp_variable(input.dtype, input.shape)
+    helper.append_op(type="elementwise_sub", inputs={"X": [input], "Y": [label]},
+                     outputs={"Out": [minus_out]})
+    sq = helper.create_tmp_variable(input.dtype, input.shape)
+    helper.append_op(type="square", inputs={"X": [minus_out]}, outputs={"Out": [sq]})
+    return sq
+
+
+def topk(input, k: int = 1, **kwargs):
+    helper = LayerHelper("top_k", **kwargs)
+    vals = helper.create_tmp_variable(input.dtype, tuple(input.shape[:-1]) + (k,))
+    idx = helper.create_tmp_variable("int64", tuple(input.shape[:-1]) + (k,))
+    helper.append_op(type="top_k", inputs={"X": [input]},
+                     outputs={"Out": [vals], "Indices": [idx]}, attrs={"k": k})
+    return vals, idx
+
+
+def accuracy(input, label, k: int = 1, **kwargs):
+    helper = LayerHelper("accuracy", **kwargs)
+    vals, idx = topk(input, k=k, **kwargs)
+    acc = helper.create_tmp_variable("float32", (1,))
+    correct = helper.create_tmp_variable("int32", ())
+    total = helper.create_tmp_variable("int32", ())
+    helper.append_op(
+        type="accuracy",
+        inputs={"Out": [vals], "Indices": [idx], "Label": [label]},
+        outputs={"Accuracy": [acc], "Correct": [correct], "Total": [total]},
+    )
+    return acc
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, **kwargs):
+    helper = LayerHelper("matmul", **kwargs)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(
+        type="matmul", inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]},
+        attrs={"transpose_X": transpose_x, "transpose_Y": transpose_y},
+    )
+    return out
+
+
+def lrn(input, n=5, k=2.0, alpha=1e-4, beta=0.75, **kwargs):
+    helper = LayerHelper("lrn", **kwargs)
+    out = helper.create_tmp_variable(input.dtype, input.shape)
+    mid = helper.create_tmp_variable("float32", input.shape)
+    helper.append_op(type="lrn", inputs={"X": [input]},
+                     outputs={"Out": [out], "MidOut": [mid]},
+                     attrs={"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return out
+
+
+def lstm(input, size: int, h0=None, c0=None, param_attr=None, bias_attr=None,
+         use_peepholes: bool = False, is_reverse: bool = False,
+         gate_activation="sigmoid", cell_activation="tanh",
+         candidate_activation="tanh", **kwargs):
+    """Fused LSTM over padded (B, T, 4*size) gate projections; pair with
+    an fc(num_flatten_dims=2) for the input projection.  Reference API:
+    fluid layers dynamic_lstm (layers/nn.py:134)."""
+    helper = LayerHelper("lstm", param_attr=param_attr, bias_attr=bias_attr, **kwargs)
+    dtype = input.dtype
+    w = helper.create_parameter(param_attr, shape=[size, 4 * size], dtype=dtype)
+    bias_size = 7 * size if use_peepholes else 4 * size
+    b = helper.create_parameter(bias_attr, shape=[1, bias_size], dtype=dtype, is_bias=True)
+    batch = input.shape[0]
+    time = input.shape[1]
+    hidden = helper.create_tmp_variable(dtype, (batch, time, size))
+    cell = helper.create_tmp_variable(dtype, (batch, time, size))
+    bg = helper.create_tmp_variable(dtype, input.shape)
+    bc = helper.create_tmp_variable(dtype, (batch, time, size))
+    inputs = {"Input": [input], "Weight": [w], "Bias": [b]}
+    if h0 is not None:
+        inputs["H0"] = [h0]
+    if c0 is not None:
+        inputs["C0"] = [c0]
+    helper.append_op(
+        type="lstm",
+        inputs=inputs,
+        outputs={"Hidden": [hidden], "Cell": [cell], "BatchGate": [bg],
+                 "BatchCellPreAct": [bc]},
+        attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation},
+    )
+    return hidden, cell
+
+
+dynamic_lstm = lstm

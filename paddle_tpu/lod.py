@@ -1,0 +1,103 @@
+"""LoD (level-of-detail) ragged tensors, TPU-style.
+
+The reference represents variable-length sequence batches as a packed
+dense tensor plus multi-level offset tables (reference:
+paddle/framework/lod_tensor.h:33-110, parameter/Argument.h:84-90), and
+runs kernels directly over the ragged layout.  A static-shape compiler
+wants the opposite: **dense padded data + explicit length/offset arrays
+as device values**, with LoD-aware ops implemented by masking/segment
+arithmetic so everything stays jittable.
+
+``LoDArray`` is a pytree: ``data`` is the packed (sum_len, ...) dense
+tensor exactly like the reference layout, ``lod`` is a tuple of
+int32 offset vectors, one per level (level 0 outermost).  Offsets are
+traced device values, so programs stay shape-polymorphic in content but
+static in buffer sizes: a batch is padded to a bucketed max total
+length by the data feeder, with ``nseq``/offsets marking validity.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class LoDArray:
+    """Packed ragged tensor: dense ``data`` + offset tables ``lod``."""
+
+    def __init__(self, data, lod: Tuple = ()):
+        self.data = data
+        self.lod = tuple(lod)
+
+    # -- pytree protocol ----------------------------------------------------
+    def tree_flatten(self):
+        return (self.data, self.lod), len(self.lod)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, lod = children
+        return cls(data, lod)
+
+    # -- api ----------------------------------------------------------------
+    @property
+    def lod_level(self) -> int:
+        return len(self.lod)
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def last_level(self):
+        """Finest-grained offsets (sequence boundaries into ``data`` rows)."""
+        return self.lod[-1]
+
+    def num_sequences(self):
+        return self.lod[-1].shape[0] - 1
+
+    def seq_lens(self):
+        off = self.lod[-1]
+        return off[1:] - off[:-1]
+
+    def __repr__(self):
+        return f"LoDArray(data={self.data.shape}, lod_level={self.lod_level})"
+
+
+def create_lod_array(data, lod: Sequence[Sequence[int]] = ()) -> LoDArray:
+    """Build a LoDArray from numpy data + python offset lists (the
+    reference's ``create_lod_tensor`` analog)."""
+    data = jnp.asarray(data)
+    offs = tuple(jnp.asarray(np.asarray(l, dtype=np.int32)) for l in lod)
+    return LoDArray(data, offs)
+
+
+def lod_from_seq_lens(seq_lens: Sequence[int]) -> np.ndarray:
+    out = np.zeros(len(seq_lens) + 1, dtype=np.int32)
+    np.cumsum(np.asarray(seq_lens, dtype=np.int32), out=out[1:])
+    return out
+
+
+def row_segment_ids(offsets, num_rows: int):
+    """segment id per packed row given offsets (n_seq+1,); rows beyond the
+    last offset get id == n_seq (an out-of-range bucket for padding)."""
+    rows = jnp.arange(num_rows, dtype=jnp.int32)
+    # id = number of offsets[1:] that are <= row
+    return jnp.searchsorted(offsets[1:], rows, side="right").astype(jnp.int32)
+
+
+def unwrap(x):
+    return x.data if isinstance(x, LoDArray) else x
+
+
+def rewrap(template, data):
+    if isinstance(template, LoDArray):
+        return LoDArray(data, template.lod)
+    return data

@@ -1,0 +1,9 @@
+"""Model zoo built on the layers API (reference acceptance corpus:
+benchmark/paddle/image/{resnet,alexnet,googlenet,vgg}.py,
+v1_api_demo/mnist, benchmark/paddle/rnn/rnn.py)."""
+
+from paddle_tpu.models.resnet import resnet_imagenet, resnet_cifar10
+from paddle_tpu.models.lenet import lenet5
+from paddle_tpu.models.vgg import vgg16
+from paddle_tpu.models.alexnet import alexnet
+from paddle_tpu.models.lstm_text import lstm_text_classifier

@@ -1,0 +1,16 @@
+"""Op library: every op registers a JAX lowering rule.
+
+Importing this package registers the full op set (reference inventory:
+paddle/operators/, 176 registrations — see SURVEY.md §2.2)."""
+
+from paddle_tpu.ops import tensor_ops  # noqa: F401
+from paddle_tpu.ops import math_ops  # noqa: F401
+from paddle_tpu.ops import activation_ops  # noqa: F401
+from paddle_tpu.ops import nn_ops  # noqa: F401
+from paddle_tpu.ops import loss_ops  # noqa: F401
+from paddle_tpu.ops import reduce_ops  # noqa: F401
+from paddle_tpu.ops import optimizer_ops  # noqa: F401
+from paddle_tpu.ops import metric_ops  # noqa: F401
+from paddle_tpu.ops import sequence_ops  # noqa: F401
+from paddle_tpu.ops import control_flow_ops  # noqa: F401
+from paddle_tpu.ops import collective_ops  # noqa: F401

@@ -1,0 +1,80 @@
+"""Activation ops (reference: operators/activation_op.cc registers the
+sigmoid/relu/tanh/... family; gradients here come from jax.vjp of the
+forward lowering instead of hand-written ActivationGradKernels)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.common import unary
+from paddle_tpu.registry import register_op
+
+
+def _reg(name, fn):
+    @register_op(name, inputs=("X",))
+    def _act(ctx, fn=fn):
+        unary(ctx, lambda x: _apply(ctx, fn, x))
+
+
+def _apply(ctx, fn, x):
+    try:
+        return fn(x, ctx)
+    except TypeError:
+        return fn(x)
+
+
+_SIMPLE = {
+    "sigmoid": jax.nn.sigmoid,
+    "logsigmoid": jax.nn.log_sigmoid,
+    "exp": jnp.exp,
+    "relu": jax.nn.relu,
+    "tanh": jnp.tanh,
+    "sqrt": jnp.sqrt,
+    "abs": jnp.abs,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "round": jnp.round,
+    "reciprocal": lambda x: 1.0 / x,
+    "log": jnp.log,
+    "square": jnp.square,
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "tanh_shrink": lambda x: x - jnp.tanh(x),
+}
+
+for _n, _f in _SIMPLE.items():
+    _reg(_n, _f)
+
+_WITH_ATTRS = {
+    "leaky_relu": lambda x, ctx: jnp.where(x >= 0, x, x * ctx.attr("alpha", 0.02)),
+    "elu": lambda x, ctx: jnp.where(x >= 0, x, ctx.attr("alpha", 1.0) * (jnp.exp(x) - 1)),
+    "relu6": lambda x, ctx: jnp.clip(x, 0.0, ctx.attr("threshold", 6.0)),
+    "pow": lambda x, ctx: jnp.power(x, ctx.attr("factor", 1.0)),
+    "stanh": lambda x, ctx: ctx.attr("scale_b", 1.7159) * jnp.tanh(ctx.attr("scale_a", 2.0 / 3.0) * x),
+    "brelu": lambda x, ctx: jnp.clip(x, ctx.attr("t_min", 0.0), ctx.attr("t_max", 24.0)),
+    "soft_relu": lambda x, ctx: jnp.log1p(jnp.exp(jnp.clip(x, -ctx.attr("threshold", 40.0), ctx.attr("threshold", 40.0)))),
+    "softshrink": lambda x, ctx: jnp.where(
+        x > ctx.attr("lambda", 0.5), x - ctx.attr("lambda", 0.5),
+        jnp.where(x < -ctx.attr("lambda", 0.5), x + ctx.attr("lambda", 0.5), 0.0)
+    ),
+    "hard_shrink": lambda x, ctx: jnp.where(jnp.abs(x) > ctx.attr("threshold", 0.5), x, 0.0),
+    "thresholded_relu": lambda x, ctx: jnp.where(x > ctx.attr("threshold", 1.0), x, 0.0),
+    "hard_sigmoid": lambda x, ctx: jnp.clip(
+        ctx.attr("slope", 0.2) * x + ctx.attr("offset", 0.5), 0.0, 1.0
+    ),
+    "swish": lambda x, ctx: x * jax.nn.sigmoid(ctx.attr("beta", 1.0) * x),
+}
+
+for _n, _f in _WITH_ATTRS.items():
+    _reg(_n, _f)
+
+
+@register_op("prelu", inputs=("X", "Alpha"))
+def _prelu(ctx):
+    from paddle_tpu.lod import rewrap, unwrap
+
+    x = ctx.input("X")
+    alpha = unwrap(ctx.input("Alpha"))
+    xd = unwrap(x)
+    ctx.set_output("Out", rewrap(x, jnp.where(xd >= 0, xd, alpha * xd)))

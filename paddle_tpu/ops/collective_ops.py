@@ -1,0 +1,82 @@
+"""Collective ops.
+
+The reference exposes NCCL collectives as ops
+(operators/nccl_op.cc:19-100) driven by an explicit Communicator.  On
+TPU there is no communicator object: when the Executor compiles a block
+under a sharded strategy, XLA inserts the collectives implied by the
+sharding annotations (psum for data-parallel grads, etc.) and routes
+them over ICI.  These explicit ops exist for programs that want manual
+collectives inside ``shard_map``-style lowering (parallel.Strategy
+spmd mode); under single-device compilation they are identity/no-ops,
+matching nccl semantics on one rank.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.lod import rewrap, unwrap
+from paddle_tpu.registry import register_op
+
+
+def _axis(ctx):
+    return ctx.attr("axis_name", "dp")
+
+
+@register_op("all_reduce", inputs=("X",))
+def _all_reduce(ctx):
+    x = ctx.input("X")
+    red = ctx.attr("reduction", "sum")
+    try:
+        if red == "sum":
+            out = lax.psum(unwrap(x), _axis(ctx))
+        elif red == "mean":
+            out = lax.pmean(unwrap(x), _axis(ctx))
+        elif red == "max":
+            out = lax.pmax(unwrap(x), _axis(ctx))
+        elif red == "min":
+            out = lax.pmin(unwrap(x), _axis(ctx))
+        else:
+            raise ValueError(red)
+    except NameError:
+        out = unwrap(x)  # single-device / unsharded compilation
+    ctx.set_output("Out", rewrap(x, out))
+
+
+# nccl-style aliases for the reference op names
+@register_op("ncclAllReduce", inputs=("X",))
+def _nccl_all_reduce(ctx):
+    x = ctx.input("X")
+    try:
+        out = lax.psum(unwrap(x), _axis(ctx))
+    except NameError:
+        out = unwrap(x)
+    ctx.set_output("Out", rewrap(x, out))
+
+
+@register_op("broadcast", inputs=("X",))
+def _broadcast(ctx):
+    # Under SPMD every replica already holds the value; identity.
+    ctx.set_output("Out", ctx.input("X"))
+
+
+@register_op("all_gather", inputs=("X",))
+def _all_gather(ctx):
+    x = ctx.input("X")
+    try:
+        out = lax.all_gather(unwrap(x), _axis(ctx), tiled=True)
+    except NameError:
+        out = unwrap(x)
+    ctx.set_output("Out", rewrap(x, out))
+
+
+@register_op("reduce_scatter", inputs=("X",))
+def _reduce_scatter(ctx):
+    x = ctx.input("X")
+    try:
+        out = lax.psum_scatter(unwrap(x), _axis(ctx), tiled=True)
+    except NameError:
+        out = unwrap(x)
+    ctx.set_output("Out", rewrap(x, out))

@@ -1,0 +1,46 @@
+"""Shared helpers for op lowerings."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from paddle_tpu.lod import LoDArray, unwrap, rewrap
+
+
+def jnp_dtype(name: str):
+    return {"bfloat16": jnp.bfloat16}.get(name, np.dtype(name))
+
+
+def broadcast_to_x(x, y, axis: int = -1):
+    """Reference elementwise broadcast rule
+    (paddle/operators/elementwise_op_function.h): Y's dims align to a
+    contiguous run of X's dims starting at ``axis`` (-1 = trailing)."""
+    x_ = unwrap(x)
+    y_ = unwrap(y)
+    if x_.shape == y_.shape:
+        return y_
+    # trim trailing 1s from y (reference trims them before matching)
+    yshape = list(y_.shape)
+    while yshape and yshape[-1] == 1 and len(yshape) > 1:
+        yshape = yshape[:-1]
+    if axis == -1:
+        axis = x_.ndim - len(yshape)
+    full = [1] * x_.ndim
+    for i, s in enumerate(yshape):
+        full[axis + i] = s
+    return jnp.reshape(y_, full)
+
+
+def elementwise(ctx, fn):
+    x = ctx.input("X")
+    y = ctx.input("Y")
+    axis = ctx.attr("axis", -1)
+    out = fn(unwrap(x), broadcast_to_x(x, y, axis))
+    ctx.set_output("Out", rewrap(x, out))
+
+
+def unary(ctx, fn, slot_in="X", slot_out="Out"):
+    x = ctx.input(slot_in)
+    ctx.set_output(slot_out, rewrap(x, fn(unwrap(x))))

@@ -1,0 +1,24 @@
+"""Control-flow-adjacent ops (reference: operators/{is_empty,increment,
+array ops}).  Structured while/cond lowering lives with the layers that
+build sub-blocks; these are the leaf utilities."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.lod import unwrap
+from paddle_tpu.registry import register_op
+
+
+@register_op("is_empty", inputs=("X",), stop_gradient=True)
+def _is_empty(ctx):
+    x = unwrap(ctx.input("X"))
+    ctx.set_output("Out", jnp.asarray(x.size == 0))
+
+
+@register_op("multiplex", inputs=("Ids", "X"), diff_inputs=("X",))
+def _multiplex(ctx):
+    ids = unwrap(ctx.input("Ids")).astype(jnp.int32).reshape(-1)
+    xs = jnp.stack([unwrap(v) for v in ctx.inputs("X")])  # (K, N, D)
+    rows = jnp.arange(ids.shape[0])
+    ctx.set_output("Out", xs[ids, rows])

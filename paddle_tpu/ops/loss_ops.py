@@ -1,0 +1,143 @@
+"""Loss ops.
+
+Reference: paddle/operators/{cross_entropy,softmax_with_cross_entropy,
+sigmoid_cross_entropy_with_logits,smooth_l1_loss,huber_loss,hinge_loss,
+rank_loss,margin_rank_loss,log_loss,squared_l2_distance}_op.cc
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.lod import rewrap, unwrap
+from paddle_tpu.registry import register_op
+
+
+def _take_label_prob(x, label):
+    """x: (N, D) probs; label: (N, 1) or (N,) int -> (N, 1)."""
+    lab = label.astype(jnp.int32)
+    if lab.ndim == 2 and lab.shape[-1] == 1:
+        lab = lab[:, 0]
+    picked = jnp.take_along_axis(x, lab[:, None], axis=1)
+    return picked
+
+
+@register_op("cross_entropy", inputs=("X", "Label"), outputs=("Y",), diff_inputs=("X",))
+def _cross_entropy(ctx):
+    """-log p[label] over a probability input (reference:
+    operators/cross_entropy_op.cc; soft_label supported)."""
+    x = unwrap(ctx.input("X")).astype(jnp.float32)
+    label = unwrap(ctx.input("Label"))
+    eps = 1e-12
+    if ctx.attr("soft_label", False):
+        y = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        y = -jnp.log(_take_label_prob(x, label) + eps)
+    ctx.set_output("Y", rewrap(ctx.input("X"), y))
+
+
+@register_op("softmax_with_cross_entropy", inputs=("Logits", "Label"),
+             outputs=("Softmax", "Loss"), diff_inputs=("Logits",))
+def _softmax_with_cross_entropy(ctx):
+    logits = unwrap(ctx.input("Logits")).astype(jnp.float32)
+    label = unwrap(ctx.input("Label"))
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ctx.set_output("Softmax", jnp.exp(logp))
+    if ctx.attr("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        loss = -_take_label_prob(logp, label)
+    ctx.set_output("Loss", loss)
+
+
+@register_op("sigmoid_cross_entropy_with_logits", inputs=("X", "Label"),
+             diff_inputs=("X",))
+def _sigmoid_ce(ctx):
+    x = unwrap(ctx.input("X"))
+    label = unwrap(ctx.input("Label")).astype(x.dtype)
+    # max(x,0) - x*z + log(1+exp(-|x|)), numerically stable
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ctx.set_output("Out", loss)
+
+
+@register_op("smooth_l1_loss", inputs=("X", "Y", "InsideWeight", "OutsideWeight"),
+             outputs=("Diff", "Out"), diff_inputs=("X", "Y"))
+def _smooth_l1(ctx):
+    x = unwrap(ctx.input("X"))
+    y = unwrap(ctx.input("Y"))
+    sigma = ctx.attr("sigma", 1.0)
+    sigma2 = sigma * sigma
+    diff = x - y
+    if ctx.has_input("InsideWeight"):
+        diff = diff * unwrap(ctx.input("InsideWeight"))
+    ctx.set_output("Diff", diff)
+    ad = jnp.abs(diff)
+    loss = jnp.where(ad < 1.0 / sigma2, 0.5 * sigma2 * jnp.square(diff), ad - 0.5 / sigma2)
+    if ctx.has_input("OutsideWeight"):
+        loss = loss * unwrap(ctx.input("OutsideWeight"))
+    ctx.set_output("Out", jnp.sum(loss, axis=tuple(range(1, loss.ndim))).reshape(-1, 1))
+
+
+@register_op("huber_loss", inputs=("X", "Y"), outputs=("Residual", "Out"),
+             diff_inputs=("X", "Y"))
+def _huber(ctx):
+    x = unwrap(ctx.input("X"))
+    y = unwrap(ctx.input("Y"))
+    delta = ctx.attr("delta", 1.0)
+    r = y - x
+    ctx.set_output("Residual", r)
+    ar = jnp.abs(r)
+    out = jnp.where(ar <= delta, 0.5 * jnp.square(r), delta * (ar - 0.5 * delta))
+    ctx.set_output("Out", out)
+
+
+@register_op("hinge_loss", inputs=("Logits", "Labels"), outputs=("Loss",),
+             diff_inputs=("Logits",))
+def _hinge(ctx):
+    logits = unwrap(ctx.input("Logits"))
+    labels = unwrap(ctx.input("Labels")).astype(logits.dtype)
+    ctx.set_output("Loss", jnp.maximum(1.0 - (2.0 * labels - 1.0) * logits, 0.0))
+
+
+@register_op("rank_loss", inputs=("Label", "Left", "Right"), outputs=("Out",),
+             diff_inputs=("Left", "Right"))
+def _rank_loss(ctx):
+    label = unwrap(ctx.input("Label"))
+    left = unwrap(ctx.input("Left"))
+    right = unwrap(ctx.input("Right"))
+    d = left - right
+    ctx.set_output("Out", jnp.log1p(jnp.exp(d)) - label * d)
+
+
+@register_op("margin_rank_loss", inputs=("Label", "X1", "X2"),
+             outputs=("Out", "Activated"), diff_inputs=("X1", "X2"))
+def _margin_rank_loss(ctx):
+    label = unwrap(ctx.input("Label"))
+    x1 = unwrap(ctx.input("X1"))
+    x2 = unwrap(ctx.input("X2"))
+    margin = ctx.attr("margin", 0.0)
+    raw = -label * (x1 - x2) + margin
+    act = (raw > 0).astype(x1.dtype)
+    ctx.set_output("Activated", act)
+    ctx.set_output("Out", act * raw)
+
+
+@register_op("log_loss", inputs=("Predicted", "Labels"), outputs=("Loss",),
+             diff_inputs=("Predicted",))
+def _log_loss(ctx):
+    p = unwrap(ctx.input("Predicted"))
+    l = unwrap(ctx.input("Labels"))
+    eps = ctx.attr("epsilon", 1e-4)
+    ctx.set_output("Loss", -l * jnp.log(p + eps) - (1 - l) * jnp.log(1 - p + eps))
+
+
+@register_op("modified_huber_loss", inputs=("X", "Y"),
+             outputs=("IntermediateVal", "Out"), diff_inputs=("X",))
+def _modified_huber(ctx):
+    x = unwrap(ctx.input("X"))
+    y = unwrap(ctx.input("Y")).astype(x.dtype)
+    z = (2.0 * y - 1.0) * x
+    ctx.set_output("IntermediateVal", z)
+    out = jnp.where(z < -1.0, -4.0 * z, jnp.square(jnp.maximum(1.0 - z, 0.0)))
+    ctx.set_output("Out", out)

@@ -1,0 +1,186 @@
+"""Dense math ops.
+
+Reference: paddle/operators/{mul,matmul,elementwise_*,sum,scale,sign,
+clip,clip_by_norm,cos_sim,squared_l2_norm,squared_l2_distance,cast,
+logical_*,compare}_op.cc — all lowered to jnp/lax so the MXU gets
+large fused matmuls instead of per-op kernel launches.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+
+from paddle_tpu.lod import rewrap, unwrap
+from paddle_tpu.ops.common import broadcast_to_x, elementwise, unary
+from paddle_tpu.registry import register_op
+
+
+def _pref():
+    from paddle_tpu import amp
+
+    return amp.preferred_acc()
+
+
+def _flatten2d(x, num_col_dims):
+    lead = 1
+    for s in x.shape[:num_col_dims]:
+        lead *= s
+    rest = 1
+    for s in x.shape[num_col_dims:]:
+        rest *= s
+    return jnp.reshape(x, (lead, rest))
+
+
+@register_op("mul", inputs=("X", "Y"))
+def _mul(ctx):
+    """Flattening matmul (reference: operators/mul_op.cc): X flattened to
+    2-D at x_num_col_dims, Y at y_num_col_dims."""
+    x = unwrap(ctx.input("X"))
+    y = unwrap(ctx.input("Y"))
+    xn = ctx.attr("x_num_col_dims", 1)
+    yn = ctx.attr("y_num_col_dims", 1)
+    from paddle_tpu import amp
+
+    out_dt = amp.out_dtype(x)
+    x2, y2 = amp.cast_operands(_flatten2d(x, xn), _flatten2d(y, yn))
+    out = jnp.dot(x2, y2, preferred_element_type=_pref()).astype(out_dt)
+    out_shape = x.shape[:xn] + y.shape[yn:]
+    ctx.set_output("Out", rewrap(ctx.input("X"), jnp.reshape(out, out_shape)))
+
+
+@register_op("matmul", inputs=("X", "Y"))
+def _matmul(ctx):
+    x = unwrap(ctx.input("X"))
+    y = unwrap(ctx.input("Y"))
+    if ctx.attr("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if ctx.attr("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    from paddle_tpu import amp
+
+    out_dt = amp.out_dtype(x)
+    x, y = amp.cast_operands(x, y)
+    out = jnp.matmul(x, y, preferred_element_type=_pref()).astype(out_dt)
+    ctx.set_output("Out", out)
+
+
+for name, fn in [
+    ("elementwise_add", jnp.add),
+    ("elementwise_sub", jnp.subtract),
+    ("elementwise_mul", jnp.multiply),
+    ("elementwise_div", jnp.divide),
+    ("elementwise_max", jnp.maximum),
+    ("elementwise_min", jnp.minimum),
+    ("elementwise_pow", jnp.power),
+]:
+    register_op(name, inputs=("X", "Y"))(functools.partial(lambda ctx, f: elementwise(ctx, f), f=fn))
+
+
+@register_op("sum", inputs=("X",))
+def _sum(ctx):
+    xs = [unwrap(v) for v in ctx.inputs("X")]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    ctx.set_output("Out", rewrap(ctx.inputs("X")[0], out))
+
+
+@register_op("scale", inputs=("X",))
+def _scale(ctx):
+    s = ctx.attr("scale", 1.0)
+    b = ctx.attr("bias", 0.0)
+    unary(ctx, lambda x: x * jnp.asarray(s, x.dtype) + jnp.asarray(b, x.dtype))
+
+
+@register_op("sign", inputs=("X",), stop_gradient=True)
+def _sign(ctx):
+    unary(ctx, jnp.sign)
+
+
+@register_op("clip", inputs=("X",))
+def _clip(ctx):
+    lo, hi = ctx.attr("min"), ctx.attr("max")
+    unary(ctx, lambda x: jnp.clip(x, lo, hi))
+
+
+@register_op("clip_by_norm", inputs=("X",))
+def _clip_by_norm(ctx):
+    max_norm = ctx.attr("max_norm")
+    def f(x):
+        norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+        scale = jnp.minimum(max_norm / jnp.maximum(norm, 1e-12), 1.0)
+        return x * scale
+    unary(ctx, f)
+
+
+@register_op("squared_l2_norm", inputs=("X",))
+def _squared_l2_norm(ctx):
+    unary(ctx, lambda x: jnp.sum(jnp.square(x)).reshape(1))
+
+
+@register_op("squared_l2_distance", inputs=("X", "Y"), outputs=("sub_result", "Out"))
+def _squared_l2_distance(ctx):
+    x = unwrap(ctx.input("X"))
+    y = broadcast_to_x(x, ctx.input("Y"), 0)
+    sub = x - y
+    ctx.set_output("sub_result", sub)
+    ctx.set_output("Out", jnp.sum(jnp.square(sub), axis=tuple(range(1, sub.ndim))).reshape(-1, 1))
+
+
+@register_op("cos_sim", inputs=("X", "Y"), outputs=("Out", "XNorm", "YNorm"))
+def _cos_sim(ctx):
+    x = unwrap(ctx.input("X"))
+    y = unwrap(ctx.input("Y"))
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / (xn * yn + 1e-12)
+    ctx.set_output("Out", out)
+    ctx.set_output("XNorm", xn)
+    ctx.set_output("YNorm", yn)
+
+
+def _register_compare(name, fn):
+    @register_op(name, inputs=("X", "Y"), stop_gradient=True)
+    def _cmp(ctx, fn=fn):
+        x = ctx.input("X")
+        y = ctx.input("Y")
+        out = fn(unwrap(x), broadcast_to_x(x, y, ctx.attr("axis", -1)))
+        ctx.set_output("Out", rewrap(x, out))
+
+
+for name, fn in [
+    ("less_than", jnp.less),
+    ("less_equal", jnp.less_equal),
+    ("greater_than", jnp.greater),
+    ("greater_equal", jnp.greater_equal),
+    ("equal", jnp.equal),
+    ("not_equal", jnp.not_equal),
+    ("logical_and", jnp.logical_and),
+    ("logical_or", jnp.logical_or),
+    ("logical_xor", jnp.logical_xor),
+]:
+    _register_compare(name, fn)
+
+
+@register_op("logical_not", inputs=("X",), stop_gradient=True)
+def _logical_not(ctx):
+    unary(ctx, jnp.logical_not)
+
+
+@register_op("minus", inputs=("X", "Y"))
+def _minus(ctx):
+    x = ctx.input("X")
+    ctx.set_output("Out", rewrap(x, unwrap(x) - unwrap(ctx.input("Y"))))
+
+
+@register_op("bilinear_tensor_product", inputs=("X", "Y", "Weight", "Bias"))
+def _bilinear_tensor_product(ctx):
+    x = unwrap(ctx.input("X"))  # (B, M)
+    y = unwrap(ctx.input("Y"))  # (B, N)
+    w = unwrap(ctx.input("Weight"))  # (K, M, N)
+    out = jnp.einsum("bm,kmn,bn->bk", x, w, y)
+    if ctx.has_input("Bias"):
+        out = out + unwrap(ctx.input("Bias"))
+    ctx.set_output("Out", out)

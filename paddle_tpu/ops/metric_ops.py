@@ -1,0 +1,60 @@
+"""Metric ops (reference: operators/{accuracy,top_k,auc,precision_recall}_op.cc)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.lod import unwrap
+from paddle_tpu.registry import register_op
+
+
+@register_op("top_k", inputs=("X",), outputs=("Out", "Indices"), stop_gradient=True)
+def _top_k(ctx):
+    x = unwrap(ctx.input("X"))
+    k = ctx.attr("k", 1)
+    vals, idx = lax.top_k(x, k)
+    ctx.set_output("Out", vals)
+    ctx.set_output("Indices", idx.astype(jnp.int64))
+
+
+@register_op("accuracy", inputs=("Out", "Indices", "Label"),
+             outputs=("Accuracy", "Correct", "Total"), stop_gradient=True)
+def _accuracy(ctx):
+    """Top-k accuracy given top_k's outputs (reference:
+    operators/accuracy_op.cc)."""
+    idx = unwrap(ctx.input("Indices"))
+    label = unwrap(ctx.input("Label")).astype(idx.dtype)
+    if label.ndim == 2 and label.shape[-1] == 1:
+        label = label[:, :1]
+    else:
+        label = label.reshape(-1, 1)
+    hit = jnp.any(idx == label, axis=1)
+    n = idx.shape[0]
+    correct = jnp.sum(hit.astype(jnp.int32))
+    ctx.set_output("Correct", correct)
+    ctx.set_output("Total", jnp.asarray(n, jnp.int32))
+    ctx.set_output("Accuracy", (correct / n).astype(jnp.float32).reshape(1))
+
+
+@register_op("auc", inputs=("Out", "Indices", "Label"), outputs=("AUC",),
+             stop_gradient=True)
+def _auc(ctx):
+    """Single-batch ROC-AUC estimate via thresholded trapezoid rule
+    (reference: operators/auc_op.cc)."""
+    probs = unwrap(ctx.input("Out"))
+    label = unwrap(ctx.input("Label")).reshape(-1)
+    score = probs[:, -1] if probs.ndim == 2 else probs.reshape(-1)
+    num_t = ctx.attr("num_thresholds", 200)
+    thresholds = jnp.linspace(0.0, 1.0, num_t)
+    pred = score[None, :] >= thresholds[:, None]
+    pos = (label > 0)[None, :]
+    tp = jnp.sum(pred & pos, axis=1).astype(jnp.float32)
+    fp = jnp.sum(pred & ~pos, axis=1).astype(jnp.float32)
+    p_total = jnp.maximum(jnp.sum(pos), 1)
+    n_total = jnp.maximum(jnp.sum(~pos), 1)
+    tpr = tp / p_total
+    fpr = fp / n_total
+    auc = -jnp.trapezoid(tpr, fpr)
+    ctx.set_output("AUC", auc.reshape(1))

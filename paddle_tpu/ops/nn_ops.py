@@ -1,0 +1,249 @@
+"""NN layer ops: conv, pool, norm, dropout, softmax.
+
+Reference: paddle/operators/{conv,pool,batch_norm,dropout,softmax,lrn,
+conv_transpose,maxout}_op.cc.  All NCHW (the reference layout); XLA's
+layout assignment maps them onto the MXU/VPU natively, so no cudnn-style
+per-op algorithm choice exists here — the whole block fuses.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.lod import rewrap, unwrap
+from paddle_tpu.registry import register_op
+
+
+def _pref():
+    from paddle_tpu import amp
+
+    return amp.preferred_acc()
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v, v)
+
+
+@register_op("conv2d", inputs=("Input", "Filter"), outputs=("Output",))
+def _conv2d(ctx):
+    """NCHW conv, filter (O, I/groups, H, W), groups supported
+    (reference: operators/conv_op.cc)."""
+    from paddle_tpu import amp
+
+    x = unwrap(ctx.input("Input"))
+    w = unwrap(ctx.input("Filter"))
+    strides = _pair(ctx.attr("strides", (1, 1)))
+    pads = _pair(ctx.attr("paddings", (0, 0)))
+    dilations = _pair(ctx.attr("dilations", (1, 1)))
+    groups = ctx.attr("groups", 1)
+    out_dt = amp.out_dtype(x)
+    x, w = amp.cast_operands(x, w)
+    out = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dilations,
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=_pref(),
+    ).astype(out_dt)
+    ctx.set_output("Output", out)
+
+
+@register_op("conv3d", inputs=("Input", "Filter"), outputs=("Output",))
+def _conv3d(ctx):
+    x = unwrap(ctx.input("Input"))
+    w = unwrap(ctx.input("Filter"))
+    strides = tuple(ctx.attr("strides", (1, 1, 1)))
+    pads = tuple(ctx.attr("paddings", (0, 0, 0)))
+    dilations = tuple(ctx.attr("dilations", (1, 1, 1)))
+    groups = ctx.attr("groups", 1)
+    out = lax.conv_general_dilated(
+        x, w,
+        window_strides=strides,
+        padding=[(p, p) for p in pads],
+        rhs_dilation=dilations,
+        feature_group_count=groups,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        preferred_element_type=_pref(),
+    ).astype(x.dtype)
+    ctx.set_output("Output", out)
+
+
+@register_op("conv2d_transpose", inputs=("Input", "Filter"), outputs=("Output",))
+def _conv2d_transpose(ctx):
+    """Gradient-of-conv as a forward op (reference:
+    operators/conv_transpose_op.cc).  Filter layout (I, O, H, W)."""
+    x = unwrap(ctx.input("Input"))
+    w = unwrap(ctx.input("Filter"))
+    strides = _pair(ctx.attr("strides", (1, 1)))
+    pads = _pair(ctx.attr("paddings", (0, 0)))
+    dilations = _pair(ctx.attr("dilations", (1, 1)))
+    out = lax.conv_transpose(
+        x,
+        w,
+        strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        transpose_kernel=True,
+    ).astype(x.dtype)
+    ctx.set_output("Output", out)
+
+
+@register_op("pool2d", inputs=("X",))
+def _pool2d(ctx):
+    x = unwrap(ctx.input("X"))
+    ptype = ctx.attr("pooling_type", "max")
+    ksize = _pair(ctx.attr("ksize", (2, 2)))
+    strides = _pair(ctx.attr("strides", (1, 1)))
+    pads = _pair(ctx.attr("paddings", (0, 0)))
+    if ctx.attr("global_pooling", False):
+        ksize = x.shape[2:4]
+        strides = (1, 1)
+        pads = (0, 0)
+    window = (1, 1) + ksize
+    strides4 = (1, 1) + strides
+    padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    if ptype == "max":
+        init = -jnp.inf
+        out = lax.reduce_window(x, init, lax.max, window, strides4, padding)
+    else:
+        summed = lax.reduce_window(x.astype(jnp.float32), 0.0, lax.add, window, strides4, padding)
+        if ctx.attr("exclusive", False):
+            ones = jnp.ones_like(x, dtype=jnp.float32)
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, strides4, padding)
+            out = (summed / counts).astype(x.dtype)
+        else:
+            out = (summed / (ksize[0] * ksize[1])).astype(x.dtype)
+    ctx.set_output("Out", out)
+
+
+@register_op("batch_norm",
+             inputs=("X", "Scale", "Bias", "Mean", "Variance"),
+             outputs=("Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"),
+             diff_inputs=("X", "Scale", "Bias"))
+def _batch_norm(ctx):
+    """Training/inference BN over NCHW channel axis 1 (reference:
+    operators/batch_norm_op.cc).  MeanOut/VarianceOut are the running
+    statistics (written back to the same persistable vars, functionally)."""
+    x = unwrap(ctx.input("X"))
+    scale = unwrap(ctx.input("Scale"))
+    bias = unwrap(ctx.input("Bias"))
+    mean = unwrap(ctx.input("Mean"))
+    var = unwrap(ctx.input("Variance"))
+    eps = ctx.attr("epsilon", 1e-5)
+    momentum = ctx.attr("momentum", 0.9)
+    is_test = ctx.attr("is_test", False)
+    layout = ctx.attr("data_layout", "NCHW")
+    c_axis = 1 if layout == "NCHW" else x.ndim - 1
+    red_axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = [1] * x.ndim
+    bshape[c_axis] = x.shape[c_axis]
+
+    xf = x.astype(jnp.float32)
+    if is_test:
+        use_mean, use_var = mean, var
+        saved_mean, saved_var = mean, var
+        new_mean, new_var = mean, var
+    else:
+        use_mean = jnp.mean(xf, axis=red_axes)
+        use_var = jnp.mean(jnp.square(xf), axis=red_axes) - jnp.square(use_mean)
+        saved_mean, saved_var = use_mean, use_var
+        new_mean = momentum * mean + (1 - momentum) * use_mean
+        new_var = momentum * var + (1 - momentum) * use_var
+
+    inv = lax.rsqrt(use_var + eps)
+    y = (xf - use_mean.reshape(bshape)) * inv.reshape(bshape)
+    y = y * scale.reshape(bshape) + bias.reshape(bshape)
+    ctx.set_output("Y", y.astype(x.dtype))
+    ctx.set_output("MeanOut", new_mean)
+    ctx.set_output("VarianceOut", new_var)
+    ctx.set_output("SavedMean", saved_mean)
+    ctx.set_output("SavedVariance", saved_var)
+
+
+def _dropout_grad_lower(ctx):
+    """d(out)/d(x) = mask (already scaled)."""
+    gout = ctx.input("Out@GRAD")
+    mask = ctx.values[ctx.op.attr("__fwd_outputs__")["Mask"][0]]
+    gname = ctx.op.outputs["X@GRAD"][0]
+    from paddle_tpu.lod import LoDArray
+
+    g = unwrap(gout) * mask
+    ctx.values[gname] = rewrap(gout, g)
+
+
+@register_op("dropout", inputs=("X",), outputs=("Out", "Mask"),
+             grad_lower=_dropout_grad_lower)
+def _dropout(ctx):
+    x = ctx.input("X")
+    xd = unwrap(x)
+    p = ctx.attr("dropout_prob", 0.5)
+    if ctx.attr("is_test", False):
+        ctx.set_output("Out", x)
+        ctx.set_output("Mask", jnp.ones_like(xd))
+        return
+    keep = jax.random.bernoulli(ctx.rng(), 1.0 - p, xd.shape)
+    # inverted dropout: scale at train time
+    mask = keep.astype(xd.dtype) / jnp.asarray(1.0 - p, xd.dtype)
+    ctx.set_output("Out", rewrap(x, xd * mask))
+    ctx.set_output("Mask", mask)
+
+
+@register_op("softmax", inputs=("X",))
+def _softmax(ctx):
+    unary_in = ctx.input("X")
+    x = unwrap(unary_in)
+    ctx.set_output("Out", rewrap(unary_in, jax.nn.softmax(x, axis=-1)))
+
+
+@register_op("lrn", inputs=("X",), outputs=("Out", "MidOut"))
+def _lrn(ctx):
+    """Local response norm across channels (reference: operators/lrn_op.cc)."""
+    x = unwrap(ctx.input("X"))
+    n = ctx.attr("n", 5)
+    k = ctx.attr("k", 2.0)
+    alpha = ctx.attr("alpha", 1e-4)
+    beta = ctx.attr("beta", 0.75)
+    sq = jnp.square(x.astype(jnp.float32))
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i : i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    ctx.set_output("MidOut", mid)
+    ctx.set_output("Out", (x / jnp.power(mid, beta)).astype(x.dtype))
+
+
+@register_op("maxout", inputs=("X",))
+def _maxout(ctx):
+    x = unwrap(ctx.input("X"))
+    groups = ctx.attr("groups")
+    n, c, h, w = x.shape
+    ctx.set_output("Out", jnp.max(x.reshape(n, c // groups, groups, h, w), axis=2))
+
+
+@register_op("pad", inputs=("X",))
+def _pad(ctx):
+    x = unwrap(ctx.input("X"))
+    paddings = ctx.attr("paddings")
+    val = ctx.attr("pad_value", 0.0)
+    cfg = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    ctx.set_output("Out", jnp.pad(x, cfg, constant_values=val))
+
+
+@register_op("crop", inputs=("X", "Y"))
+def _crop(ctx):
+    x = unwrap(ctx.input("X"))
+    offsets = ctx.attr("offsets")
+    if ctx.has_input("Y"):
+        shape = unwrap(ctx.input("Y")).shape
+    else:
+        shape = ctx.attr("shape")
+    sl = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    ctx.set_output("Out", x[sl])

@@ -1,0 +1,204 @@
+"""Optimizer update ops (reference: paddle/operators/{sgd,momentum,adam,
+adamax,adagrad,adadelta,decayed_adagrad,rmsprop,ftrl,proximal_gd,
+proximal_adagrad}_op.cc).  Pure elementwise updates; with the whole step
+compiled as one XLA program, every optimizer fuses into the backward
+pass — there is no separate "apply" launch as in the reference."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.lod import unwrap
+from paddle_tpu.registry import register_op
+
+
+def _lr(ctx):
+    lr = unwrap(ctx.input("LearningRate"))
+    return lr.reshape(()) if hasattr(lr, "reshape") else lr
+
+
+@register_op("sgd", inputs=("Param", "Grad", "LearningRate"),
+             outputs=("ParamOut",), stop_gradient=True)
+def _sgd(ctx):
+    p = unwrap(ctx.input("Param"))
+    g = unwrap(ctx.input("Grad"))
+    ctx.set_output("ParamOut", p - _lr(ctx).astype(p.dtype) * g.astype(p.dtype))
+
+
+@register_op("momentum", inputs=("Param", "Grad", "Velocity", "LearningRate"),
+             outputs=("ParamOut", "VelocityOut"), stop_gradient=True)
+def _momentum(ctx):
+    p = unwrap(ctx.input("Param"))
+    g = unwrap(ctx.input("Grad")).astype(p.dtype)
+    v = unwrap(ctx.input("Velocity"))
+    mu = ctx.attr("mu", 0.9)
+    lr = _lr(ctx).astype(p.dtype)
+    v_new = mu * v + g
+    if ctx.attr("use_nesterov", False):
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    ctx.set_output("ParamOut", p_new)
+    ctx.set_output("VelocityOut", v_new)
+
+
+@register_op("adam",
+             inputs=("Param", "Grad", "LearningRate", "Moment1", "Moment2",
+                     "Beta1Pow", "Beta2Pow"),
+             outputs=("ParamOut", "Moment1Out", "Moment2Out"),
+             stop_gradient=True)
+def _adam(ctx):
+    p = unwrap(ctx.input("Param"))
+    g = unwrap(ctx.input("Grad")).astype(jnp.float32)
+    m1 = unwrap(ctx.input("Moment1"))
+    m2 = unwrap(ctx.input("Moment2"))
+    b1p = unwrap(ctx.input("Beta1Pow")).reshape(())
+    b2p = unwrap(ctx.input("Beta2Pow")).reshape(())
+    b1 = ctx.attr("beta1", 0.9)
+    b2 = ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    lr = _lr(ctx)
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_new = p.astype(jnp.float32) - lr_t * m1n / (jnp.sqrt(m2n) + eps)
+    ctx.set_output("ParamOut", p_new.astype(p.dtype))
+    ctx.set_output("Moment1Out", m1n)
+    ctx.set_output("Moment2Out", m2n)
+
+
+@register_op("adamax",
+             inputs=("Param", "Grad", "LearningRate", "Moment", "InfNorm", "Beta1Pow"),
+             outputs=("ParamOut", "MomentOut", "InfNormOut"), stop_gradient=True)
+def _adamax(ctx):
+    p = unwrap(ctx.input("Param"))
+    g = unwrap(ctx.input("Grad")).astype(jnp.float32)
+    m = unwrap(ctx.input("Moment"))
+    u = unwrap(ctx.input("InfNorm"))
+    b1p = unwrap(ctx.input("Beta1Pow")).reshape(())
+    b1 = ctx.attr("beta1", 0.9)
+    b2 = ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    lr = _lr(ctx)
+    m_new = b1 * m + (1 - b1) * g
+    u_new = jnp.maximum(b2 * u, jnp.abs(g))
+    p_new = p.astype(jnp.float32) - (lr / (1 - b1p)) * m_new / (u_new + eps)
+    ctx.set_output("ParamOut", p_new.astype(p.dtype))
+    ctx.set_output("MomentOut", m_new)
+    ctx.set_output("InfNormOut", u_new)
+
+
+@register_op("adagrad", inputs=("Param", "Grad", "Moment", "LearningRate"),
+             outputs=("ParamOut", "MomentOut"), stop_gradient=True)
+def _adagrad(ctx):
+    p = unwrap(ctx.input("Param"))
+    g = unwrap(ctx.input("Grad")).astype(jnp.float32)
+    m = unwrap(ctx.input("Moment"))
+    eps = ctx.attr("epsilon", 1e-6)
+    m_new = m + jnp.square(g)
+    p_new = p.astype(jnp.float32) - _lr(ctx) * g / (jnp.sqrt(m_new) + eps)
+    ctx.set_output("ParamOut", p_new.astype(p.dtype))
+    ctx.set_output("MomentOut", m_new)
+
+
+@register_op("decayed_adagrad", inputs=("Param", "Grad", "Moment", "LearningRate"),
+             outputs=("ParamOut", "MomentOut"), stop_gradient=True)
+def _decayed_adagrad(ctx):
+    p = unwrap(ctx.input("Param"))
+    g = unwrap(ctx.input("Grad")).astype(jnp.float32)
+    m = unwrap(ctx.input("Moment"))
+    decay = ctx.attr("decay", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    m_new = decay * m + (1 - decay) * jnp.square(g)
+    p_new = p.astype(jnp.float32) - _lr(ctx) * g / (jnp.sqrt(m_new) + eps)
+    ctx.set_output("ParamOut", p_new.astype(p.dtype))
+    ctx.set_output("MomentOut", m_new)
+
+
+@register_op("adadelta", inputs=("Param", "Grad", "AvgSquaredGrad", "AvgSquaredUpdate"),
+             outputs=("ParamOut", "AvgSquaredGradOut", "AvgSquaredUpdateOut"),
+             stop_gradient=True)
+def _adadelta(ctx):
+    p = unwrap(ctx.input("Param"))
+    g = unwrap(ctx.input("Grad")).astype(jnp.float32)
+    ag = unwrap(ctx.input("AvgSquaredGrad"))
+    au = unwrap(ctx.input("AvgSquaredUpdate"))
+    rho = ctx.attr("rho", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    ag_new = rho * ag + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((au + eps) / (ag_new + eps)) * g
+    au_new = rho * au + (1 - rho) * jnp.square(update)
+    ctx.set_output("ParamOut", (p.astype(jnp.float32) + update).astype(p.dtype))
+    ctx.set_output("AvgSquaredGradOut", ag_new)
+    ctx.set_output("AvgSquaredUpdateOut", au_new)
+
+
+@register_op("rmsprop", inputs=("Param", "MeanSquare", "LearningRate", "Grad", "Moment"),
+             outputs=("ParamOut", "MomentOut", "MeanSquareOut"), stop_gradient=True)
+def _rmsprop(ctx):
+    p = unwrap(ctx.input("Param"))
+    g = unwrap(ctx.input("Grad")).astype(jnp.float32)
+    ms = unwrap(ctx.input("MeanSquare"))
+    mom = unwrap(ctx.input("Moment"))
+    eps = ctx.attr("epsilon", 1e-10)
+    decay = ctx.attr("decay", 0.9)
+    momentum = ctx.attr("momentum", 0.0)
+    ms_new = decay * ms + (1 - decay) * jnp.square(g)
+    mom_new = momentum * mom + _lr(ctx) * g / jnp.sqrt(ms_new + eps)
+    ctx.set_output("ParamOut", (p.astype(jnp.float32) - mom_new).astype(p.dtype))
+    ctx.set_output("MomentOut", mom_new)
+    ctx.set_output("MeanSquareOut", ms_new)
+
+
+@register_op("ftrl",
+             inputs=("Param", "SquaredAccumulator", "LinearAccumulator", "Grad",
+                     "LearningRate"),
+             outputs=("ParamOut", "SquaredAccumOut", "LinearAccumOut"),
+             stop_gradient=True)
+def _ftrl(ctx):
+    p = unwrap(ctx.input("Param")).astype(jnp.float32)
+    sq = unwrap(ctx.input("SquaredAccumulator"))
+    lin = unwrap(ctx.input("LinearAccumulator"))
+    g = unwrap(ctx.input("Grad")).astype(jnp.float32)
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    lr_power = ctx.attr("lr_power", -0.5)
+    lr = _lr(ctx)
+    new_sq = sq + jnp.square(g)
+    sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
+    new_lin = lin + g - sigma * p
+    pre = -jnp.sign(new_lin) * jnp.maximum(jnp.abs(new_lin) - l1, 0.0)
+    denom = jnp.power(new_sq, -lr_power) / lr + 2 * l2
+    p_new = pre / denom
+    ctx.set_output("ParamOut", p_new.astype(unwrap(ctx.input("Param")).dtype))
+    ctx.set_output("SquaredAccumOut", new_sq)
+    ctx.set_output("LinearAccumOut", new_lin)
+
+
+@register_op("proximal_gd", inputs=("Param", "Grad", "LearningRate"),
+             outputs=("ParamOut",), stop_gradient=True)
+def _proximal_gd(ctx):
+    p = unwrap(ctx.input("Param")).astype(jnp.float32)
+    g = unwrap(ctx.input("Grad")).astype(jnp.float32)
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    lr = _lr(ctx)
+    prox = p - lr * g
+    p_new = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) / (1.0 + lr * l2)
+    ctx.set_output("ParamOut", p_new.astype(unwrap(ctx.input("Param")).dtype))
+
+
+@register_op("proximal_adagrad", inputs=("Param", "Moment", "Grad", "LearningRate"),
+             outputs=("ParamOut", "MomentOut"), stop_gradient=True)
+def _proximal_adagrad(ctx):
+    p = unwrap(ctx.input("Param")).astype(jnp.float32)
+    m = unwrap(ctx.input("Moment"))
+    g = unwrap(ctx.input("Grad")).astype(jnp.float32)
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    m_new = m + jnp.square(g)
+    lr_eff = _lr(ctx) / jnp.sqrt(m_new)
+    prox = p - lr_eff * g
+    p_new = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr_eff * l1, 0.0) / (1.0 + lr_eff * l2)
+    ctx.set_output("ParamOut", p_new.astype(unwrap(ctx.input("Param")).dtype))
+    ctx.set_output("MomentOut", m_new)

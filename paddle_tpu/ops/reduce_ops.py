@@ -1,0 +1,44 @@
+"""Reduce ops (reference: operators/reduce_op.cc, mean_op.cc)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.lod import unwrap
+from paddle_tpu.ops.common import unary
+from paddle_tpu.registry import register_op
+
+
+@register_op("mean", inputs=("X",))
+def _mean(ctx):
+    x = unwrap(ctx.input("X"))
+    ctx.set_output("Out", jnp.mean(x).reshape(()))
+
+
+def _reg_reduce(name, fn):
+    @register_op(name, inputs=("X",))
+    def _red(ctx, fn=fn):
+        x = unwrap(ctx.input("X"))
+        dim = ctx.attr("dim", 0)
+        keep = ctx.attr("keep_dim", False)
+        if ctx.attr("reduce_all", False):
+            out = fn(x)
+            if keep:
+                out = out.reshape((1,) * x.ndim)
+            ctx.set_output("Out", out)
+            return
+        ctx.set_output("Out", fn(x, axis=dim, keepdims=keep))
+
+
+for _n, _f in [
+    ("reduce_sum", jnp.sum),
+    ("reduce_mean", jnp.mean),
+    ("reduce_max", jnp.max),
+    ("reduce_min", jnp.min),
+]:
+    _reg_reduce(_n, _f)
+
+
+@register_op("l1_norm", inputs=("X",))
+def _l1_norm(ctx):
+    unary(ctx, lambda x: jnp.sum(jnp.abs(x)).reshape(()))

@@ -1,0 +1,235 @@
+"""Sequence / LoD ops.
+
+Reference: operators/{sequence_pool,sequence_softmax,sequence_concat,
+sequence_expand,seq_expand,lod_reset,sequence_slice}_op.cc and the
+fused RNN ops operators/{lstm,gru}_op.cc.
+
+TPU design: LoDArray = packed dense rows + offset vectors as traced
+device values (see paddle_tpu.lod).  Ragged reductions become
+segment-sum/max over static row counts; the fused RNNs run `lax.scan`
+over a batch-major padded view (reference analog:
+operators/math/sequence2batch.h) so each step is one big MXU matmul.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.lod import LoDArray, row_segment_ids, unwrap
+from paddle_tpu.registry import register_op
+
+
+def _seg_ids(x: LoDArray):
+    off = x.last_level()
+    return row_segment_ids(off, x.data.shape[0]), off.shape[0] - 1
+
+
+@register_op("sequence_pool", inputs=("X",), outputs=("Out", "MaxIndex"))
+def _sequence_pool(ctx):
+    x = ctx.input("X")
+    assert isinstance(x, LoDArray), "sequence_pool needs a LoD input"
+    ids, nseq = _seg_ids(x)
+    data = x.data
+    ptype = ctx.attr("pooltype", "AVERAGE").upper()
+    if ptype == "SUM":
+        out = jax.ops.segment_sum(data, ids, num_segments=nseq)
+    elif ptype == "AVERAGE":
+        s = jax.ops.segment_sum(data, ids, num_segments=nseq)
+        lens = x.seq_lens().astype(data.dtype).reshape(-1, *([1] * (data.ndim - 1)))
+        out = s / jnp.maximum(lens, 1)
+    elif ptype == "SQRT":
+        s = jax.ops.segment_sum(data, ids, num_segments=nseq)
+        lens = x.seq_lens().astype(data.dtype).reshape(-1, *([1] * (data.ndim - 1)))
+        out = s / jnp.sqrt(jnp.maximum(lens, 1))
+    elif ptype == "MAX":
+        out = jax.ops.segment_max(data, ids, num_segments=nseq)
+        ctx.has_output("MaxIndex") and ctx.set_output(
+            "MaxIndex", jnp.zeros((nseq,) + data.shape[1:], jnp.int32)
+        )
+    elif ptype == "LAST":
+        off = x.last_level()
+        out = jnp.take(data, jnp.maximum(off[1:] - 1, 0), axis=0)
+    elif ptype == "FIRST":
+        off = x.last_level()
+        out = jnp.take(data, off[:-1], axis=0)
+    else:
+        raise ValueError(f"unknown pooltype {ptype}")
+    ctx.set_output("Out", out)
+
+
+@register_op("sequence_softmax", inputs=("X",))
+def _sequence_softmax(ctx):
+    x = ctx.input("X")
+    assert isinstance(x, LoDArray)
+    ids, nseq = _seg_ids(x)
+    data = x.data.reshape(-1)
+    mx = jax.ops.segment_max(data, ids, num_segments=nseq)
+    shifted = data - mx[ids]
+    e = jnp.exp(shifted)
+    denom = jax.ops.segment_sum(e, ids, num_segments=nseq)
+    out = e / denom[ids]
+    # padding rows (ids == nseq would be OOB; they index garbage via clip)
+    valid = ids < nseq
+    out = jnp.where(valid, out, 0.0)
+    ctx.set_output("Out", LoDArray(out.reshape(x.data.shape), x.lod))
+
+
+@register_op("sequence_concat", inputs=("X",))
+def _sequence_concat(ctx):
+    """Concat along the feature axis for same-LoD inputs (axis=1), the
+    common case of reference sequence_concat_op."""
+    xs = ctx.inputs("X")
+    axis = ctx.attr("axis", 0)
+    if axis == 1:
+        out = jnp.concatenate([unwrap(v) for v in xs], axis=1)
+        ctx.set_output("Out", LoDArray(out, xs[0].lod))
+    else:
+        raise NotImplementedError("sequence_concat axis=0 requires re-packing; TODO")
+
+
+@register_op("seq_expand", inputs=("X", "Y"), diff_inputs=("X",))
+def _seq_expand(ctx):
+    """Expand X's rows so each input row/sequence repeats to match Y's
+    LoD (reference: operators/seq_expand_op.cc)."""
+    x = ctx.input("X")
+    y = ctx.input("Y")
+    assert isinstance(y, LoDArray)
+    y_off = y.last_level()
+    n_out = y.data.shape[0]
+    ids = row_segment_ids(y_off, n_out)
+    xd = unwrap(x)
+    out = jnp.take(xd, jnp.clip(ids, 0, xd.shape[0] - 1), axis=0)
+    ctx.set_output("Out", LoDArray(out, y.lod))
+
+
+@register_op("lod_reset", inputs=("X", "TargetLoD"))
+def _lod_reset(ctx):
+    x = ctx.input("X")
+    data = unwrap(x)
+    if ctx.has_input("TargetLoD"):
+        target = unwrap(ctx.input("TargetLoD")).astype(jnp.int32)
+    else:
+        target = jnp.asarray(ctx.attr("target_lod"), jnp.int32)
+    ctx.set_output("Out", LoDArray(data, (target,)))
+
+
+@register_op("lstm",
+             inputs=("Input", "H0", "C0", "Weight", "Bias"),
+             outputs=("Hidden", "Cell", "BatchGate", "BatchCellPreAct"),
+             diff_inputs=("Input", "H0", "C0", "Weight", "Bias"))
+def _lstm(ctx):
+    """Fused LSTM over a padded batch-major tensor.
+
+    Reference: operators/lstm_op.cc runs gate-matmuls per LoD batch via
+    sequence2batch; here Input is (batch, time, 4*hidden) pre-projected
+    gate activations (the reference's layout: input already multiplied
+    by W_x in a `mul` op), Weight is the recurrent (hidden, 4*hidden),
+    Bias (1, 4*hidden [+ 3*hidden peephole]).  Lowering = lax.scan over
+    time with one (batch, hidden) x (hidden, 4*hidden) MXU matmul per
+    step; padding handled by a length mask if Input is a LoDArray.
+    """
+    x_in = ctx.input("Input")
+    is_lod = isinstance(x_in, LoDArray)
+    if is_lod:
+        raise NotImplementedError(
+            "LoD input to fused lstm: feed padded (batch, time, 4H) instead"
+        )
+    x = unwrap(x_in)  # (B, T, 4H)
+    B, T, H4 = x.shape
+    H = H4 // 4
+    w = unwrap(ctx.input("Weight"))  # (H, 4H)
+    bias = unwrap(ctx.input("Bias")) if ctx.has_input("Bias") else None
+    use_peepholes = ctx.attr("use_peepholes", False) and bias is not None and bias.shape[-1] == 7 * H
+    b_gate = bias[..., : 4 * H].reshape(1, 4 * H) if bias is not None else 0.0
+
+    h0 = unwrap(ctx.input("H0")) if ctx.has_input("H0") else jnp.zeros((B, H), x.dtype)
+    c0 = unwrap(ctx.input("C0")) if ctx.has_input("C0") else jnp.zeros((B, H), x.dtype)
+
+    gate_act = _act_fn(ctx.attr("gate_activation", "sigmoid"))
+    cell_act = _act_fn(ctx.attr("cell_activation", "tanh"))
+    cand_act = _act_fn(ctx.attr("candidate_activation", "tanh"))
+
+    if use_peepholes:
+        w_ic = bias[..., 4 * H : 5 * H].reshape(1, H)
+        w_fc = bias[..., 5 * H : 6 * H].reshape(1, H)
+        w_oc = bias[..., 6 * H : 7 * H].reshape(1, H)
+
+    def step(carry, xt):
+        h, c = carry
+        gates = xt + jnp.dot(h, w, preferred_element_type=jnp.float32).astype(x.dtype) + b_gate
+        i, f, ct_, o = jnp.split(gates, 4, axis=-1)
+        if use_peepholes:
+            i = gate_act(i + w_ic * c)
+            f = gate_act(f + w_fc * c)
+        else:
+            i = gate_act(i)
+            f = gate_act(f)
+        cand = cand_act(ct_)
+        c_new = f * c + i * cand
+        o = gate_act(o + w_oc * c_new) if use_peepholes else gate_act(o)
+        h_new = o * cell_act(c_new)
+        return (h_new, c_new), (h_new, c_new)
+
+    xs = jnp.swapaxes(x, 0, 1)  # (T, B, 4H)
+    if ctx.attr("is_reverse", False):
+        xs = xs[::-1]
+    (_, _), (hs, cs) = lax.scan(step, (h0, c0), xs)
+    if ctx.attr("is_reverse", False):
+        hs, cs = hs[::-1], cs[::-1]
+    hidden = jnp.swapaxes(hs, 0, 1)  # (B, T, H)
+    cell = jnp.swapaxes(cs, 0, 1)
+    ctx.set_output("Hidden", hidden)
+    ctx.set_output("Cell", cell)
+    if ctx.has_output("BatchGate"):
+        ctx.set_output("BatchGate", x)
+    if ctx.has_output("BatchCellPreAct"):
+        ctx.set_output("BatchCellPreAct", cell)
+
+
+@register_op("gru",
+             inputs=("Input", "H0", "Weight", "Bias"),
+             outputs=("Hidden", "BatchGate", "BatchResetHiddenPrev", "BatchHidden"),
+             diff_inputs=("Input", "H0", "Weight", "Bias"))
+def _gru(ctx):
+    """Fused GRU (reference: operators/gru_op.cc).  Input (B, T, 3H) of
+    pre-projected gates; Weight packs W_rz (H, 2H) and W_c (H, H)."""
+    x = unwrap(ctx.input("Input"))
+    B, T, H3 = x.shape
+    H = H3 // 3
+    w = unwrap(ctx.input("Weight"))  # (H, 3H): [:, :2H]=update/reset, [:, 2H:]=candidate
+    w_rz = w[:, : 2 * H]
+    w_c = w[:, 2 * H :]
+    bias = unwrap(ctx.input("Bias")).reshape(1, 3 * H) if ctx.has_input("Bias") else jnp.zeros((1, 3 * H), x.dtype)
+    h0 = unwrap(ctx.input("H0")) if ctx.has_input("H0") else jnp.zeros((B, H), x.dtype)
+    gate_act = _act_fn(ctx.attr("gate_activation", "sigmoid"))
+    cand_act = _act_fn(ctx.attr("activation", "tanh"))
+
+    def step(h, xt):
+        uz = xt[:, : 2 * H] + jnp.dot(h, w_rz, preferred_element_type=jnp.float32).astype(x.dtype) + bias[:, : 2 * H]
+        u, r = jnp.split(gate_act(uz), 2, axis=-1)
+        c = cand_act(xt[:, 2 * H :] + jnp.dot(r * h, w_c, preferred_element_type=jnp.float32).astype(x.dtype) + bias[:, 2 * H :])
+        h_new = u * h + (1 - u) * c
+        return h_new, h_new
+
+    xs = jnp.swapaxes(x, 0, 1)
+    if ctx.attr("is_reverse", False):
+        xs = xs[::-1]
+    _, hs = lax.scan(step, h0, xs)
+    if ctx.attr("is_reverse", False):
+        hs = hs[::-1]
+    hidden = jnp.swapaxes(hs, 0, 1)
+    ctx.set_output("Hidden", hidden)
+    for slot in ("BatchGate", "BatchResetHiddenPrev", "BatchHidden"):
+        if ctx.has_output(slot):
+            ctx.set_output(slot, hidden)
+
+
+def _act_fn(name):
+    return {
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "relu": jax.nn.relu,
+        "identity": lambda v: v,
+    }[name]

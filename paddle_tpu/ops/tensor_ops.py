@@ -1,0 +1,186 @@
+"""Tensor creation / movement ops.
+
+Reference: paddle/operators/{fill_constant,fill_zeros_like,assign,cast,
+uniform_random,gaussian_random,increment,concat,split,reshape,transpose,
+expand,gather,scatter,fill_constant_batch_size_like,...}_op.cc
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.lod import LoDArray, rewrap, unwrap
+from paddle_tpu.ops.common import jnp_dtype, unary
+from paddle_tpu.registry import register_op
+
+
+@register_op("fill_constant", inputs=(), stop_gradient=True)
+def _fill_constant(ctx):
+    shape = tuple(ctx.attr("shape", ()))
+    dtype = jnp_dtype(ctx.attr("dtype", "float32"))
+    value = ctx.attr("value", 0.0)
+    ctx.set_output("Out", jnp.full(shape, value, dtype=dtype))
+
+
+@register_op("fill_constant_batch_size_like", inputs=("Input",), stop_gradient=True)
+def _fill_constant_bsl(ctx):
+    ref = unwrap(ctx.input("Input"))
+    shape = list(ctx.attr("shape"))
+    in_idx = ctx.attr("input_dim_idx", 0)
+    out_idx = ctx.attr("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx]
+    dtype = jnp_dtype(ctx.attr("dtype", "float32"))
+    ctx.set_output("Out", jnp.full(tuple(shape), ctx.attr("value", 0.0), dtype=dtype))
+
+
+@register_op("fill_zeros_like", inputs=("X",), stop_gradient=True)
+def _fill_zeros_like(ctx):
+    unary(ctx, jnp.zeros_like)
+
+
+@register_op("assign", inputs=("X",))
+def _assign(ctx):
+    ctx.set_output("Out", ctx.input("X"))
+
+
+@register_op("cast", inputs=("X",))
+def _cast(ctx):
+    dtype = jnp_dtype(ctx.attr("out_dtype", ctx.attr("dtype", "float32")))
+    unary(ctx, lambda x: x.astype(dtype))
+
+
+@register_op("uniform_random", inputs=(), stop_gradient=True)
+def _uniform_random(ctx):
+    shape = tuple(ctx.attr("shape"))
+    dtype = jnp_dtype(ctx.attr("dtype", "float32"))
+    lo, hi = ctx.attr("min", -1.0), ctx.attr("max", 1.0)
+    seed = ctx.attr("seed", 0)
+    key = jax.random.key(seed) if seed else ctx.rng()
+    ctx.set_output("Out", jax.random.uniform(key, shape, dtype=jnp.float32, minval=lo, maxval=hi).astype(dtype))
+
+
+@register_op("gaussian_random", inputs=(), stop_gradient=True)
+def _gaussian_random(ctx):
+    shape = tuple(ctx.attr("shape"))
+    dtype = jnp_dtype(ctx.attr("dtype", "float32"))
+    mean, std = ctx.attr("mean", 0.0), ctx.attr("std", 1.0)
+    seed = ctx.attr("seed", 0)
+    key = jax.random.key(seed) if seed else ctx.rng()
+    ctx.set_output("Out", (jax.random.normal(key, shape) * std + mean).astype(dtype))
+
+
+@register_op("increment", inputs=("X",), stop_gradient=True)
+def _increment(ctx):
+    step = ctx.attr("step", 1.0)
+    unary(ctx, lambda x: x + jnp.asarray(step, x.dtype))
+
+
+@register_op("concat", inputs=("X",))
+def _concat(ctx):
+    xs = ctx.inputs("X")
+    axis = ctx.attr("axis", 0)
+    datas = [unwrap(x) for x in xs]
+    ctx.set_output("Out", rewrap(xs[0], jnp.concatenate(datas, axis=axis)))
+
+
+@register_op("split", inputs=("X",))
+def _split(ctx):
+    x = unwrap(ctx.input("X"))
+    axis = ctx.attr("axis", 0)
+    num = ctx.attr("num", 0)
+    sections = ctx.attr("sections", None)
+    if sections:
+        idx = []
+        acc = 0
+        for s in sections[:-1]:
+            acc += s
+            idx.append(acc)
+        parts = jnp.split(x, idx, axis=axis)
+    else:
+        parts = jnp.split(x, num, axis=axis)
+    ctx.set_outputs("Out", parts)
+
+
+@register_op("reshape", inputs=("X",))
+def _reshape(ctx):
+    x = unwrap(ctx.input("X"))
+    shape = list(ctx.attr("shape"))
+    # one -1 wildcard and 0 = copy-input-dim, as in the reference
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    ctx.set_output("Out", jnp.reshape(x, shape))
+
+
+@register_op("transpose", inputs=("X",))
+def _transpose(ctx):
+    x = unwrap(ctx.input("X"))
+    ctx.set_output("Out", jnp.transpose(x, ctx.attr("axis")))
+
+
+@register_op("expand", inputs=("X",))
+def _expand(ctx):
+    x = unwrap(ctx.input("X"))
+    times = ctx.attr("expand_times")
+    ctx.set_output("Out", jnp.tile(x, times))
+
+
+@register_op("gather", inputs=("X", "Index"), diff_inputs=("X",))
+def _gather(ctx):
+    x = unwrap(ctx.input("X"))
+    idx = unwrap(ctx.input("Index")).astype(jnp.int32)
+    ctx.set_output("Out", jnp.take(x, idx, axis=0))
+
+
+@register_op("scatter", inputs=("Ref", "Index", "Updates"), diff_inputs=("Ref", "Updates"))
+def _scatter(ctx):
+    ref = unwrap(ctx.input("Ref"))
+    idx = unwrap(ctx.input("Index")).astype(jnp.int32)
+    upd = unwrap(ctx.input("Updates"))
+    ctx.set_output("Out", ref.at[idx].set(upd))
+
+
+@register_op("lookup_table", inputs=("W", "Ids"), diff_inputs=("W",))
+def _lookup_table(ctx):
+    """Embedding lookup (reference: operators/lookup_table_op.cc).  Ids of
+    shape (..., 1) int64; gradient w.r.t. W is a dense scatter-add (the
+    reference's SelectedRows sparse path maps to XLA scatter on TPU)."""
+    w = unwrap(ctx.input("W"))
+    ids = ctx.input("Ids")
+    ids_data = unwrap(ids).astype(jnp.int32)
+    squeeze = ids_data.shape[-1] == 1
+    flat = ids_data[..., 0] if squeeze else ids_data
+    padding_idx = ctx.attr("padding_idx", None)
+    out = jnp.take(w, flat, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (flat != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    ctx.set_output("Out", rewrap(ids, out))
+
+
+@register_op("shape", inputs=("Input",), stop_gradient=True)
+def _shape(ctx):
+    x = unwrap(ctx.input("Input"))
+    ctx.set_output("Out", jnp.asarray(x.shape, dtype=jnp.int32))
+
+
+@register_op("slice_tensor", inputs=("X",))
+def _slice_tensor(ctx):
+    x = unwrap(ctx.input("X"))
+    axes = ctx.attr("axes")
+    starts = ctx.attr("starts")
+    ends = ctx.attr("ends")
+    sl = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        sl[ax] = slice(st, en)
+    ctx.set_output("Out", x[tuple(sl)])
+
+
+@register_op("one_hot", inputs=("X",), stop_gradient=True)
+def _one_hot(ctx):
+    x = unwrap(ctx.input("X")).astype(jnp.int32)
+    if x.ndim and x.shape[-1] == 1:
+        x = x[..., 0]
+    depth = ctx.attr("depth")
+    ctx.set_output("Out", jax.nn.one_hot(x, depth, dtype=jnp.float32))

@@ -1,0 +1,13 @@
+"""SPMD parallelism: meshes, shardings, strategies.
+
+Replaces the reference's three distributed backends (MultiGradientMachine
+threads, NCCL ops, C++/Go parameter servers — SURVEY.md §2.5) with the
+TPU-native design: one compiled program, sharded over a
+``jax.sharding.Mesh``; XLA inserts psum/all_gather over ICI.
+"""
+
+from paddle_tpu.parallel.strategy import (
+    DataParallelStrategy,
+    Strategy,
+    make_mesh,
+)

@@ -1,0 +1,84 @@
+"""Parallel strategies: sharding rules the Executor applies at compile
+time.
+
+Data parallel (reference equivalents: MultiGradientMachine
+gserver/gradientmachines/MultiGradientMachine.h:30-80, ncclAllReduce
+operators/nccl_op.cu.cc:41-78, sync pserver pserver/ParameterServer2.h):
+shard every feed's batch dim over the mesh, replicate parameters, and
+let XLA turn the (replicated-out) gradient contractions into psum over
+ICI.  No gradient-merge thread, no parameter server: the collective is
+inside the step program.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(axis_sizes: Dict[str, int], devices=None) -> Mesh:
+    """Build a Mesh from {axis_name: size}; devices default to all."""
+    devices = devices if devices is not None else jax.devices()
+    names = tuple(axis_sizes)
+    sizes = tuple(axis_sizes[n] for n in names)
+    n = int(np.prod(sizes))
+    arr = np.asarray(devices[:n]).reshape(sizes)
+    return Mesh(arr, names)
+
+
+class Strategy:
+    """Base: everything replicated (single-program, multi-chip copies)."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    def state_spec(self, name: str, var) -> P:
+        return P()
+
+    def feed_spec(self, name: str, var) -> P:
+        return P()
+
+    def jit_shardings(self, block, state_names: Sequence[str],
+                      feed_names: Sequence[str], uses_rng: bool = False,
+                      out_state_names: Optional[Sequence[str]] = None):
+        state_sh = {
+            n: NamedSharding(self.mesh, self.state_spec(n, block.find_var(n)))
+            for n in state_names
+        }
+        out_state_sh = {
+            n: NamedSharding(self.mesh, self.state_spec(n, block.find_var(n)))
+            for n in (out_state_names if out_state_names is not None else state_names)
+        }
+        feed_sh = {
+            n: NamedSharding(self.mesh, self.feed_spec(n, block.find_var(n)))
+            for n in feed_names
+        }
+        replicated = NamedSharding(self.mesh, P())
+        # positional: (state, feeds[, seed]); outputs (fetches, state)
+        in_sh = [state_sh, feed_sh]
+        if uses_rng:
+            in_sh.append(replicated)
+        return {
+            "in_shardings": tuple(in_sh),
+            "out_shardings": (None, out_state_sh),
+        }
+
+
+class DataParallelStrategy(Strategy):
+    """Shard feed batch dim over ``axis``; replicate state."""
+
+    def __init__(self, mesh: Mesh, axis: str = "dp"):
+        super().__init__(mesh)
+        self.axis = axis
+
+    def feed_spec(self, name: str, var) -> P:
+        from paddle_tpu.lod import LoDArray  # noqa: F401
+
+        if var is not None and var.lod_level > 0:
+            # ragged packed rows don't shard on batch yet: replicate
+            return P()
+        return P(self.axis)

@@ -1,0 +1,28 @@
+"""Profiling (reference: python/paddle/v2/fluid/profiler.py wraps
+nvprof; the TPU equivalent is jax.profiler/xprof traces)."""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def profiler(output_dir: str = "/tmp/paddle_tpu_profile", **kwargs):
+    """Trace context: view with xprof/tensorboard."""
+    jax.profiler.start_trace(output_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+# reference-compatible alias (fluid.profiler.cuda_profiler)
+cuda_profiler = profiler
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    with jax.profiler.TraceAnnotation(name):
+        yield

@@ -1,0 +1,207 @@
+"""Op registry: ops as lowering rules.
+
+Replaces the reference's static kernel registration
+(paddle/framework/op_registry.h:36-238, op_info.h:68) with a TPU-first
+design: an op is a *lowering rule* — a Python function that, given a
+``LowerContext`` holding traced JAX values for its inputs, emits traced
+values for its outputs.  The Executor invokes lowering rules while
+tracing a whole block under ``jax.jit``; XLA then fuses and schedules —
+there is no per-op dispatch at run time.
+
+Gradients: an op may register an explicit ``grad_lower`` /
+``grad_maker``; otherwise ``append_backward`` synthesises a
+``<type>_grad`` op whose lowering applies ``jax.vjp`` to the forward
+lowering rule (reference analog: GradOpDescMakerBase,
+framework/grad_op_desc_maker.h:170).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class SkipInferShape(Exception):
+    """Raised by infer_shape rules that cannot infer statically."""
+
+
+@dataclasses.dataclass
+class OpInfo:
+    type: str
+    lower: Callable[["LowerContext"], None]
+    infer_shape: Optional[Callable] = None
+    # slots, in declaration order (for vjp-based autodiff bookkeeping)
+    input_slots: Sequence[str] = ()
+    output_slots: Sequence[str] = ()
+    # which input slots are differentiable (None = all float inputs)
+    diff_inputs: Optional[Sequence[str]] = None
+    # explicit grad lowering: fn(ctx) for op "<type>_grad"
+    grad_lower: Optional[Callable[["LowerContext"], None]] = None
+    # explicit grad maker: fn(op, no_grad_set) -> list of (type, inputs,
+    # outputs, attrs) descs.  None -> default vjp-backed maker.
+    grad_maker: Optional[Callable] = None
+    # ops with no gradient at all (metrics, fill, io...)
+    stop_gradient: bool = False
+
+
+class OpRegistry:
+    _ops: Dict[str, OpInfo] = {}
+
+    @classmethod
+    def register(cls, info: OpInfo):
+        if info.type in cls._ops:
+            raise ValueError(f"op {info.type!r} already registered")
+        cls._ops[info.type] = info
+
+    @classmethod
+    def get(cls, type: str, none_ok: bool = False) -> Optional[OpInfo]:
+        info = cls._ops.get(type)
+        if info is None and type.endswith("_grad") and type[:-5] in cls._ops:
+            from paddle_tpu.autodiff import synthesize_grad_info
+
+            info = synthesize_grad_info(type)
+        if info is None and not none_ok:
+            raise KeyError(f"op {type!r} is not registered")
+        return info
+
+    @classmethod
+    def has(cls, type: str) -> bool:
+        return type in cls._ops
+
+    @classmethod
+    def all_ops(cls) -> List[str]:
+        return sorted(cls._ops)
+
+
+def register_op(
+    type: str,
+    *,
+    inputs: Sequence[str] = (),
+    outputs: Sequence[str] = ("Out",),
+    infer_shape=None,
+    diff_inputs=None,
+    grad_lower=None,
+    grad_maker=None,
+    stop_gradient: bool = False,
+):
+    """Decorator: ``@register_op("relu", inputs=["X"])`` on a lowering fn."""
+
+    def deco(fn):
+        OpRegistry.register(
+            OpInfo(
+                type=type,
+                lower=fn,
+                infer_shape=infer_shape,
+                input_slots=tuple(inputs),
+                output_slots=tuple(outputs),
+                diff_inputs=tuple(diff_inputs) if diff_inputs is not None else None,
+                grad_lower=grad_lower,
+                grad_maker=grad_maker,
+                stop_gradient=stop_gradient,
+            )
+        )
+        return fn
+
+    return deco
+
+
+class LowerContext:
+    """Execution context handed to lowering rules (reference analog:
+    framework/operator.h ExecutionContext).
+
+    ``values`` is the traced scope: name -> jax value (or LoDArray).
+    """
+
+    def __init__(self, op, values: Dict[str, Any], rng=None, executor_ctx=None):
+        self.op = op
+        self.values = values
+        self._rng = rng  # RngState or None
+        self.executor_ctx = executor_ctx  # CompiledBlockBuilder, for block attrs
+
+    # --- inputs ------------------------------------------------------------
+
+    def has_input(self, slot: str) -> bool:
+        names = self.op.input(slot)
+        return bool(names) and all(n in self.values for n in names)
+
+    def input(self, slot: str):
+        names = self.op.input(slot)
+        if not names:
+            return None
+        if len(names) != 1:
+            raise ValueError(f"op {self.op.type}: slot {slot} has {len(names)} args")
+        return self.values[names[0]]
+
+    def inputs(self, slot: str) -> List[Any]:
+        return [self.values[n] for n in self.op.input(slot)]
+
+    def input_name(self, slot: str) -> Optional[str]:
+        names = self.op.input(slot)
+        return names[0] if names else None
+
+    # --- outputs -----------------------------------------------------------
+
+    def set_output(self, slot: str, value):
+        names = self.op.output(slot)
+        if not names:
+            return  # optional output not wired up in this program
+        if len(names) != 1:
+            raise ValueError(
+                f"op {self.op.type}: slot {slot} expects 1 output, has {names}"
+            )
+        self.values[names[0]] = value
+
+    def set_outputs(self, slot: str, vals: Sequence[Any]):
+        names = self.op.output(slot)
+        if len(names) != len(vals):
+            raise ValueError(
+                f"op {self.op.type}: slot {slot} has {len(names)} names, "
+                f"{len(vals)} values"
+            )
+        for n, v in zip(names, vals):
+            self.values[n] = v
+
+    def output_name(self, slot: str) -> Optional[str]:
+        names = self.op.output(slot)
+        return names[0] if names else None
+
+    def has_output(self, slot: str) -> bool:
+        return bool(self.op.output(slot))
+
+    # --- attrs / misc ------------------------------------------------------
+
+    def attr(self, name: str, default=None):
+        return self.op.attr(name, default)
+
+    def out_var(self, slot: str = "Out"):
+        """Static Variable metadata for an output (shape/dtype hints)."""
+        name = self.output_name(slot)
+        return self.op.block.var(name) if name else None
+
+    def rng(self):
+        """Split a fresh PRNG key off the threaded RNG state."""
+        if self._rng is None:
+            raise RuntimeError(
+                f"op {self.op.type} needs RNG but executor gave none"
+            )
+        return self._rng.next_key()
+
+
+class RngState:
+    """Functional PRNG threading through a traced block.
+
+    The executor seeds one key per run (from the program seed or a
+    counter) and every random op splits from it — keeping lowered blocks
+    pure, the way XLA wants (vs. the reference's stateful curand use).
+    """
+
+    def __init__(self, key):
+        self.key = key
+
+    def next_key(self):
+        import jax
+
+        self.key, sub = jax.random.split(self.key)
+        return sub

@@ -1,0 +1,41 @@
+"""Test config: force an 8-device virtual CPU mesh so sharding tests run
+without TPU hardware (the driver separately dry-runs multichip)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The axon TPU plugin (if present) registers itself as the default
+# backend regardless of JAX_PLATFORMS; force tests onto the virtual
+# 8-device CPU platform.
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    """Each test gets fresh default programs and a fresh global scope."""
+    from paddle_tpu import framework
+    from paddle_tpu import executor as executor_mod
+
+    framework.reset_default_programs()
+    executor_mod._global_scope = executor_mod.Scope()
+    executor_mod._scope_stack = [executor_mod._global_scope]
+    yield
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

@@ -1,0 +1,147 @@
+"""OpTest harness (reference: python/paddle/v2/fluid/tests/op_test.py —
+check_output vs a numpy reference, check_grad vs central-difference
+numeric gradients)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import grad_var_name
+from paddle_tpu.lod import LoDArray
+
+
+class OpTest:
+    """Subclass sets: op_type, inputs (slot->np array | list[(name, arr)]),
+    attrs, and either expected outputs or a numpy ref via setUp."""
+
+    op_type: str = ""
+
+    def build_and_run(
+        self,
+        inputs: Dict,
+        attrs: Dict,
+        output_slots: Sequence[str],
+        output_meta: Optional[Dict[str, Dict]] = None,
+        fetch_grads_for: Sequence[str] = (),
+        loss_slot: Optional[str] = None,
+    ):
+        import paddle_tpu.framework as framework
+
+        framework.reset_default_programs()
+        from paddle_tpu import executor as executor_mod
+
+        executor_mod._global_scope = executor_mod.Scope()
+        executor_mod._scope_stack = [executor_mod._global_scope]
+
+        prog = fluid.default_main_program()
+        block = prog.global_block()
+        feed = {}
+        in_map = {}
+        for slot, value in inputs.items():
+            entries = value if isinstance(value, list) else [(f"{slot}_var", value)]
+            names = []
+            for name, arr in entries:
+                lod_level = 1 if isinstance(arr, LoDArray) else 0
+                shape = arr.data.shape if isinstance(arr, LoDArray) else np.asarray(arr).shape
+                dtype = str(arr.data.dtype) if isinstance(arr, LoDArray) else str(np.asarray(arr).dtype)
+                block.create_var(name=name, shape=shape, dtype=dtype,
+                                 lod_level=lod_level)
+                feed[name] = arr
+                names.append(name)
+            in_map[slot] = names
+        out_map = {}
+        meta = output_meta or {}
+        for slot in output_slots:
+            name = f"{slot}_out"
+            m = meta.get(slot, {})
+            block.create_var(name=name, shape=m.get("shape"),
+                             dtype=m.get("dtype", "float32"),
+                             lod_level=m.get("lod_level", 0))
+            out_map[slot] = [name]
+        block.append_op(type=self.op_type, inputs=in_map, outputs=out_map,
+                        attrs=attrs)
+
+        fetch = [out_map[s][0] for s in output_slots]
+        if fetch_grads_for:
+            loss_name = out_map[loss_slot or output_slots[0]][0]
+            loss_var = block.var(loss_name)
+            # reduce to scalar for backward
+            mean_out = block.create_var(name="loss_mean", shape=(), dtype="float32")
+            block.append_op(type="mean", inputs={"X": [loss_name]},
+                            outputs={"Out": ["loss_mean"]})
+            fluid.append_backward(mean_out)
+            fetch += [grad_var_name(n) for n in fetch_grads_for]
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        return exe.run(prog, feed=feed, fetch_list=fetch)
+
+    # -- assertions ---------------------------------------------------------
+
+    def check_output(self, inputs, attrs, expected: Dict[str, np.ndarray],
+                     atol=1e-5, rtol=1e-5, output_meta=None):
+        slots = list(expected)
+        outs = self.build_and_run(inputs, attrs, slots, output_meta)
+        for slot, got in zip(slots, outs):
+            want = expected[slot]
+            if isinstance(got, LoDArray):
+                got = np.asarray(got.data)
+            np.testing.assert_allclose(
+                got, want, atol=atol, rtol=rtol,
+                err_msg=f"{self.op_type}.{slot} mismatch")
+
+    def check_grad(self, inputs, attrs, output_slots, wrt: Sequence[str],
+                   loss_slot=None, delta=1e-3, atol=1e-2, rtol=1e-2,
+                   output_meta=None):
+        """Analytic grads (via the framework) vs central differences of a
+        mean-of-output loss."""
+        res = self.build_and_run(inputs, attrs, output_slots, output_meta,
+                                 fetch_grads_for=wrt, loss_slot=loss_slot)
+        analytic = res[len(output_slots):]
+
+        # numeric: perturb each wrt input
+        def loss_of(feed_override):
+            outs = self._run_plain(inputs, attrs, output_slots, output_meta,
+                                   feed_override, loss_slot)
+            return outs
+
+        for gname, g in zip(wrt, analytic):
+            base = self._flat_input(inputs, gname)
+            num = np.zeros_like(base, dtype=np.float64)
+            flat = base.reshape(-1)
+            numf = num.reshape(-1)
+            for i in range(flat.size):
+                for sign in (+1, -1):
+                    pert = base.copy().reshape(-1)
+                    pert[i] += sign * delta
+                    numf[i] += sign * loss_of({gname: pert.reshape(base.shape)})
+                numf[i] /= 2 * delta
+            ga = np.asarray(g.data) if isinstance(g, LoDArray) else np.asarray(g)
+            np.testing.assert_allclose(ga, num, atol=atol, rtol=rtol,
+                                       err_msg=f"grad wrt {gname}")
+
+    def _flat_input(self, inputs, name):
+        for slot, value in inputs.items():
+            entries = value if isinstance(value, list) else [(f"{slot}_var", value)]
+            for n, arr in entries:
+                if n == name:
+                    return np.asarray(arr, dtype=np.float64).astype(np.float32)
+        raise KeyError(name)
+
+    def _run_plain(self, inputs, attrs, output_slots, output_meta, override,
+                   loss_slot):
+        new_inputs = {}
+        for slot, value in inputs.items():
+            entries = value if isinstance(value, list) else [(f"{slot}_var", value)]
+            new_entries = []
+            for n, arr in entries:
+                new_entries.append((n, override.get(n, arr)))
+            new_inputs[slot] = new_entries
+        outs = self.build_and_run(new_inputs, attrs, output_slots, output_meta)
+        loss_idx = output_slots.index(loss_slot) if loss_slot else 0
+        v = outs[loss_idx]
+        if isinstance(v, LoDArray):
+            v = np.asarray(v.data)
+        return float(np.mean(v))

@@ -1,0 +1,205 @@
+"""Per-op numeric tests vs numpy (reference model:
+python/paddle/v2/fluid/tests/test_*_op.py)."""
+
+import numpy as np
+import pytest
+
+from tests.op_test import OpTest
+
+
+class TestMul(OpTest):
+    op_type = "mul"
+
+    def test_output(self, rng):
+        x = rng.randn(4, 5).astype("float32")
+        y = rng.randn(5, 3).astype("float32")
+        self.check_output({"X": [("x", x)], "Y": [("y", y)]}, {},
+                          {"Out": x @ y}, atol=1e-4)
+
+    def test_flatten(self, rng):
+        x = rng.randn(2, 3, 4).astype("float32")
+        y = rng.randn(12, 5).astype("float32")
+        self.check_output({"X": [("x", x)], "Y": [("y", y)]},
+                          {"x_num_col_dims": 1},
+                          {"Out": x.reshape(2, 12) @ y}, atol=1e-4)
+
+    def test_grad(self, rng):
+        x = rng.randn(3, 4).astype("float32")
+        y = rng.randn(4, 2).astype("float32")
+        self.check_grad({"X": [("x", x)], "Y": [("y", y)]}, {}, ["Out"],
+                        wrt=["x", "y"])
+
+
+class TestElementwiseAdd(OpTest):
+    op_type = "elementwise_add"
+
+    def test_same_shape(self, rng):
+        x = rng.randn(3, 4).astype("float32")
+        y = rng.randn(3, 4).astype("float32")
+        self.check_output({"X": [("x", x)], "Y": [("y", y)]}, {}, {"Out": x + y})
+
+    def test_broadcast_axis1(self, rng):
+        x = rng.randn(2, 3, 4, 5).astype("float32")
+        y = rng.randn(3).astype("float32")
+        self.check_output({"X": [("x", x)], "Y": [("y", y)]}, {"axis": 1},
+                          {"Out": x + y.reshape(1, 3, 1, 1)})
+
+    def test_grad_broadcast(self, rng):
+        x = rng.randn(2, 3).astype("float32")
+        y = rng.randn(3).astype("float32")
+        self.check_grad({"X": [("x", x)], "Y": [("y", y)]}, {"axis": 1},
+                        ["Out"], wrt=["x", "y"])
+
+
+class TestSoftmax(OpTest):
+    op_type = "softmax"
+
+    def test_output(self, rng):
+        x = rng.randn(4, 7).astype("float32")
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.check_output({"X": [("x", x)]}, {}, {"Out": e / e.sum(-1, keepdims=True)})
+
+    def test_grad(self, rng):
+        x = rng.randn(3, 5).astype("float32")
+        self.check_grad({"X": [("x", x)]}, {}, ["Out"], wrt=["x"])
+
+
+class TestCrossEntropy(OpTest):
+    op_type = "cross_entropy"
+
+    def test_output(self, rng):
+        probs = rng.rand(4, 6).astype("float32") + 0.1
+        probs /= probs.sum(-1, keepdims=True)
+        labels = rng.randint(0, 6, (4, 1)).astype("int64")
+        want = -np.log(probs[np.arange(4), labels[:, 0]] + 1e-12).reshape(4, 1)
+        self.check_output(
+            {"X": [("x", probs)], "Label": [("label", labels)]}, {},
+            {"Y": want}, atol=1e-4)
+
+
+class TestConv2d(OpTest):
+    op_type = "conv2d"
+
+    def test_vs_numpy(self, rng):
+        x = rng.randn(2, 3, 5, 5).astype("float32")
+        w = rng.randn(4, 3, 3, 3).astype("float32")
+        # naive conv reference
+        out = np.zeros((2, 4, 3, 3), np.float32)
+        for n in range(2):
+            for o in range(4):
+                for i in range(3):
+                    for j in range(3):
+                        patch = x[n, :, i:i + 3, j:j + 3]
+                        out[n, o, i, j] = np.sum(patch * w[o])
+        self.check_output({"Input": [("x", x)], "Filter": [("w", w)]},
+                          {"strides": [1, 1], "paddings": [0, 0]},
+                          {"Output": out}, atol=1e-3, rtol=1e-3)
+
+    def test_grad(self, rng):
+        x = rng.randn(1, 2, 4, 4).astype("float32")
+        w = rng.randn(2, 2, 3, 3).astype("float32")
+        self.check_grad({"Input": [("x", x)], "Filter": [("w", w)]},
+                        {"strides": [1, 1], "paddings": [1, 1]},
+                        ["Output"], wrt=["x", "w"])
+
+
+class TestPool2d(OpTest):
+    op_type = "pool2d"
+
+    def test_max(self, rng):
+        x = rng.randn(1, 2, 4, 4).astype("float32")
+        want = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+        self.check_output({"X": [("x", x)]},
+                          {"pooling_type": "max", "ksize": [2, 2],
+                           "strides": [2, 2], "paddings": [0, 0]},
+                          {"Out": want})
+
+    def test_avg(self, rng):
+        x = rng.randn(1, 2, 4, 4).astype("float32")
+        want = x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+        self.check_output({"X": [("x", x)]},
+                          {"pooling_type": "avg", "ksize": [2, 2],
+                           "strides": [2, 2], "paddings": [0, 0]},
+                          {"Out": want}, atol=1e-5)
+
+
+class TestReduce(OpTest):
+    op_type = "reduce_sum"
+
+    def test_dim(self, rng):
+        x = rng.randn(3, 4, 5).astype("float32")
+        self.check_output({"X": [("x", x)]}, {"dim": 1}, {"Out": x.sum(1)},
+                          atol=1e-4)
+
+    def test_keepdim(self, rng):
+        x = rng.randn(3, 4).astype("float32")
+        self.check_output({"X": [("x", x)]}, {"dim": 0, "keep_dim": True},
+                          {"Out": x.sum(0, keepdims=True)}, atol=1e-4)
+
+
+class TestActivations(OpTest):
+    def test_relu(self, rng):
+        self.op_type = "relu"
+        x = rng.randn(4, 5).astype("float32")
+        self.check_output({"X": [("x", x)]}, {}, {"Out": np.maximum(x, 0)})
+
+    def test_sigmoid_grad(self, rng):
+        self.op_type = "sigmoid"
+        x = rng.randn(3, 4).astype("float32")
+        self.check_grad({"X": [("x", x)]}, {}, ["Out"], wrt=["x"])
+
+    def test_tanh(self, rng):
+        self.op_type = "tanh"
+        x = rng.randn(4, 5).astype("float32")
+        self.check_output({"X": [("x", x)]}, {}, {"Out": np.tanh(x)}, atol=1e-6)
+
+    def test_leaky_relu(self, rng):
+        self.op_type = "leaky_relu"
+        x = rng.randn(4, 5).astype("float32")
+        self.check_output({"X": [("x", x)]}, {"alpha": 0.1},
+                          {"Out": np.where(x >= 0, x, 0.1 * x)})
+
+
+class TestBatchNorm(OpTest):
+    op_type = "batch_norm"
+
+    def test_train_mode(self, rng):
+        x = rng.randn(4, 3, 2, 2).astype("float32")
+        scale = rng.rand(3).astype("float32")
+        bias = rng.rand(3).astype("float32")
+        mean = np.zeros(3, np.float32)
+        var = np.ones(3, np.float32)
+        mu = x.mean(axis=(0, 2, 3))
+        v = x.var(axis=(0, 2, 3))
+        want = ((x - mu.reshape(1, 3, 1, 1)) / np.sqrt(v.reshape(1, 3, 1, 1) + 1e-5)
+                ) * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+        self.check_output(
+            {"X": [("x", x)], "Scale": [("scale", scale)], "Bias": [("b", bias)],
+             "Mean": [("m", mean)], "Variance": [("v", var)]},
+            {"epsilon": 1e-5, "momentum": 0.9},
+            {"Y": want}, atol=1e-4, rtol=1e-3)
+
+
+class TestTopKAccuracy(OpTest):
+    op_type = "top_k"
+
+    def test_topk(self, rng):
+        x = rng.randn(4, 10).astype("float32")
+        self.check_output({"X": [("x", x)]}, {"k": 3},
+                          {"Out": -np.sort(-x, axis=1)[:, :3]})
+
+
+class TestLookupTable(OpTest):
+    op_type = "lookup_table"
+
+    def test_output(self, rng):
+        w = rng.randn(10, 4).astype("float32")
+        ids = rng.randint(0, 10, (5, 1)).astype("int64")
+        self.check_output({"W": [("w", w)], "Ids": [("ids", ids)]}, {},
+                          {"Out": w[ids[:, 0]]})
+
+    def test_grad(self, rng):
+        w = rng.randn(6, 3).astype("float32")
+        ids = np.array([[0], [2], [2], [5]], dtype="int64")
+        self.check_grad({"W": [("w", w)], "Ids": [("ids", ids)]}, {},
+                        ["Out"], wrt=["w"])

@@ -1,0 +1,117 @@
+"""End-to-end training convergence tests (reference model: the fluid
+"book" tests — fluid/tests/book/test_recognize_digits_conv.py trains to
+a convergence exit criterion)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def test_fit_a_line(rng):
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1)
+    cost = fluid.layers.square_error_cost(input=pred, label=y)
+    avg = fluid.layers.mean(cost)
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(avg)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    W = rng.randn(13, 1).astype("float32")
+    first = last = None
+    for i in range(300):
+        xs = rng.randn(32, 13).astype("float32")
+        ys = xs @ W + 0.5 + 0.01 * rng.randn(32, 1).astype("float32")
+        (loss,) = exe.run(feed={"x": xs, "y": ys}, fetch_list=[avg])
+        if first is None:
+            first = float(loss)
+        last = float(loss)
+    assert last < 0.05 * first, (first, last)
+
+
+def test_recognize_digits_conv(rng):
+    img = fluid.layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    c1 = fluid.nets.simple_img_conv_pool(img, 20, 5, 2, 2, act="relu")
+    c2 = fluid.nets.simple_img_conv_pool(c1, 50, 5, 2, 2, act="relu")
+    sm = fluid.layers.fc(input=c2, size=10, act="softmax")
+    loss = fluid.layers.cross_entropy(input=sm, label=label)
+    avg = fluid.layers.mean(loss)
+    acc = fluid.layers.accuracy(input=sm, label=label)
+    fluid.optimizer.Adam(learning_rate=0.001).minimize(avg)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    protos = rng.randn(10, 1, 28, 28).astype("float32")
+    a = 0.0
+    for i in range(40):
+        ys = rng.randint(0, 10, (64,)).astype("int64")
+        xs = protos[ys] + 0.3 * rng.randn(64, 1, 28, 28).astype("float32")
+        l, a = exe.run(feed={"img": xs, "label": ys.reshape(-1, 1)},
+                       fetch_list=[avg, acc])
+    assert float(a) > 0.9, float(a)
+
+
+def test_word2vec_style_embedding(rng):
+    """Embedding + fc + softmax CE trains (exercises lookup_table grad
+    scatter-add)."""
+    vocab, dim = 50, 16
+    w1 = fluid.layers.data(name="w1", shape=[1], dtype="int64")
+    nxt = fluid.layers.data(name="nxt", shape=[1], dtype="int64")
+    emb = fluid.layers.embedding(input=w1, size=[vocab, dim])
+    sm = fluid.layers.fc(input=emb, size=vocab, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(input=sm, label=nxt))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    # learnable mapping: next = (w + 7) % vocab
+    first = last = None
+    for i in range(200):
+        ws = rng.randint(0, vocab, (64, 1)).astype("int64")
+        ys = (ws + 7) % vocab
+        (l,) = exe.run(feed={"w1": ws, "nxt": ys}, fetch_list=[loss])
+        if first is None:
+            first = float(l)
+        last = float(l)
+    assert last < 0.5 * first, (first, last)
+
+
+def test_sgd_matches_manual_update(rng):
+    """One SGD step == p - lr * grad computed by hand."""
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1, bias_attr=False)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = fluid.global_scope()
+    pname = fluid.default_main_program().all_parameters()[0].name
+    w0 = np.array(scope.get(pname))
+    xs = rng.randn(8, 4).astype("float32")
+    ys = rng.randn(8, 1).astype("float32")
+    exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+    w1 = np.array(scope.get(pname))
+    # manual: loss = mean((x@w - y)^2); dL/dw = 2/N * x^T (x@w - y)
+    grad = 2.0 / 8 * xs.T @ (xs @ w0 - ys)
+    np.testing.assert_allclose(w1, w0 - 0.1 * grad, atol=1e-5, rtol=1e-4)
+
+
+def test_save_load_roundtrip(tmp_path, rng):
+    x = fluid.layers.data(name="x", shape=[5], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs = rng.randn(2, 5).astype("float32")
+    (out0,) = exe.run(feed={"x": xs}, fetch_list=[pred])
+
+    fluid.io.save_params(exe, str(tmp_path / "ckpt"))
+    scope = fluid.global_scope()
+    for p in fluid.default_main_program().all_parameters():
+        scope.set(p.name, np.zeros(p.shape, np.float32))
+    fluid.io.load_params(exe, str(tmp_path / "ckpt"))
+    (out1,) = exe.run(feed={"x": xs}, fetch_list=[pred])
+    np.testing.assert_allclose(out0, out1, atol=1e-6)

@@ -1,0 +1,105 @@
+"""Regression tests for review findings (executor cache staleness,
+sequence_pool grads, DataFeeder scalar columns, ParamAttr reuse,
+optimizer startup_program routing)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.data_feeder import DataFeeder
+from paddle_tpu.lod import create_lod_array
+from paddle_tpu.param_attr import ParamAttr
+
+
+def test_clone_for_test_does_not_reuse_train_executable(rng):
+    """A for_test clone with identical op/var counts must not hit the
+    train program's compile cache (dropout would stay active)."""
+    x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+    h = fluid.layers.fc(input=x, size=16, act="relu")
+    d = fluid.layers.dropout(x=h, dropout_prob=0.99)
+    out = fluid.layers.reduce_sum(d, dim=1)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs = rng.rand(8, 16).astype("float32") + 1.0
+
+    train_prog = fluid.default_main_program()
+    (o_train,) = exe.run(train_prog, feed={"x": xs}, fetch_list=[out])
+    test_prog = train_prog.clone(for_test=True)
+    (o_test,) = exe.run(test_prog, feed={"x": xs}, fetch_list=[out])
+    # with p=0.99 train output is almost surely ~0-heavy; test must differ
+    assert not np.allclose(o_train, o_test), "test clone reused train executable"
+    # determinism: test-mode output is dropout-free
+    (o_test2,) = exe.run(test_prog, feed={"x": xs}, fetch_list=[out])
+    np.testing.assert_allclose(o_test, o_test2)
+
+
+def test_sequence_pool_avg_backward(rng):
+    """Gradient through non-MAX sequence_pool (MaxIndex output unwritten)
+    must not crash the vjp replay."""
+    prog = fluid.default_main_program()
+    block = prog.global_block()
+    block.create_var(name="seq", shape=(8, 4), dtype="float32", lod_level=1)
+    w = block.create_parameter(shape=[4, 4], dtype="float32", name="w_sp")
+    block.create_var(name="proj", shape=(8, 4), dtype="float32", lod_level=1)
+    block.append_op(type="mul", inputs={"X": ["seq"], "Y": ["w_sp"]},
+                    outputs={"Out": ["proj"]})
+    block.create_var(name="pooled", shape=(2, 4), dtype="float32")
+    block.create_var(name="maxidx", shape=(2, 4), dtype="int32")
+    block.append_op(type="sequence_pool", inputs={"X": ["proj"]},
+                    outputs={"Out": ["pooled"], "MaxIndex": ["maxidx"]},
+                    attrs={"pooltype": "AVERAGE"})
+    block.create_var(name="loss", shape=(), dtype="float32")
+    block.append_op(type="mean", inputs={"X": ["pooled"]},
+                    outputs={"Out": ["loss"]})
+    loss = block.var("loss")
+    fluid.append_backward(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.global_scope()
+    scope.set("w_sp", rng.randn(4, 4).astype("float32"))
+    data = create_lod_array(rng.randn(8, 4).astype("float32"), [[0, 3, 8]])
+    from paddle_tpu.framework import grad_var_name
+
+    (g,) = exe.run(prog, feed={"seq": data}, fetch_list=[grad_var_name("w_sp")])
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_data_feeder_float_scalar_column():
+    x = fluid.layers.data(name="xf", shape=[3], dtype="float32")
+    y = fluid.layers.data(name="yf", shape=[1], dtype="float32")
+    feeder = DataFeeder(feed_list=[x, y])
+    batch = [(np.ones(3, "float32"), 0.5), (np.zeros(3, "float32"), 1.5)]
+    feed = feeder.feed(batch)
+    assert feed["yf"].shape == (2, 1), feed["yf"].shape
+    assert feed["xf"].shape == (2, 3)
+
+
+def test_param_attr_reuse_creates_distinct_params():
+    pa = ParamAttr(initializer=fluid.initializer.Xavier())
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    h1 = fluid.layers.fc(input=x, size=5, param_attr=pa)
+    h2 = fluid.layers.fc(input=h1, size=6, param_attr=pa)
+    assert pa.name is None, "caller ParamAttr was mutated"
+    shapes = sorted(tuple(p.shape) for p in fluid.default_main_program().all_parameters()
+                    if p.name.endswith(".w_0") or "w" in p.name)
+    assert (4, 5) in shapes and (5, 6) in shapes
+
+
+def test_minimize_routes_to_explicit_startup_program(rng):
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(input=pred, label=y))
+    # minimize OUTSIDE the guard, passing startup explicitly
+    opt = fluid.optimizer.Adam(learning_rate=0.01)
+    opt.minimize(loss, startup_program=startup)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (l,) = exe.run(main, feed={"x": rng.randn(4, 4).astype("float32"),
+                               "y": rng.randn(4, 1).astype("float32")},
+                   fetch_list=[loss])
+    assert np.isfinite(float(l))
