@@ -1,0 +1,121 @@
+"""In-graph sequence decoding: greedy + beam search.
+
+The reference implements beam search twice: RecurrentGradientMachine's
+path-expansion generator (gserver/gradientmachines/
+RecurrentGradientMachine.cpp:964,1439) and the fluid beam_search +
+beam_search_decode ops over LoD tensor arrays (operators/
+beam_search_op.cc, beam_search_decode_op.cc), both host-side and
+pointer-chasing.  On TPU the whole decode is one compiled program:
+dense (batch, beam) state, ``lax.scan`` over max_len steps, top-k
+pruning on the joint (beam x vocab) scores, and backpointer stacks
+that are re-walked in-graph at the end (the beam_search_decode
+equivalent).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e9
+
+
+class BeamState(NamedTuple):
+    tokens: jnp.ndarray       # (B, K) current token per beam
+    log_probs: jnp.ndarray    # (B, K) cumulative scores
+    finished: jnp.ndarray     # (B, K) bool
+    state: object             # model state pytree, leaves (B, K, ...)
+
+
+def _gather_beams(tree, idx):
+    """Select beams: tree leaves (B, K, ...), idx (B, K) int."""
+    def g(x):
+        return jnp.take_along_axis(
+            x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+    return jax.tree_util.tree_map(g, tree)
+
+
+def beam_search(
+    step_fn: Callable,
+    init_state,
+    batch_size: int,
+    beam_size: int,
+    vocab_size: int,
+    bos_id: int,
+    eos_id: int,
+    max_len: int,
+    length_penalty: float = 0.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Run beam search; returns (sequences (B, K, max_len), scores (B, K)),
+    best beam first.
+
+    ``step_fn(tokens, state) -> (log_probs, new_state)``: tokens (B, K)
+    int32, log_probs (B, K, V); state leaves are (B, K, ...).
+    """
+    B, K, V = batch_size, beam_size, vocab_size
+
+    init_tokens = jnp.full((B, K), bos_id, jnp.int32)
+    # only beam 0 is live initially (others would duplicate it)
+    init_lp = jnp.tile(jnp.asarray([0.0] + [NEG_INF] * (K - 1)), (B, 1))
+    init = BeamState(init_tokens, init_lp, jnp.zeros((B, K), bool), init_state)
+
+    def step(carry, _):
+        bs = carry
+        logp, new_state = step_fn(bs.tokens, bs.state)  # (B, K, V)
+        logp = jax.nn.log_softmax(logp.astype(jnp.float32), axis=-1)
+        # finished beams only extend with EOS at no cost
+        eos_only = jnp.full((B, K, V), NEG_INF).at[:, :, eos_id].set(0.0)
+        logp = jnp.where(bs.finished[..., None], eos_only, logp)
+        total = bs.log_probs[..., None] + logp          # (B, K, V)
+        flat = total.reshape(B, K * V)
+        top_scores, top_idx = lax.top_k(flat, K)        # (B, K)
+        beam_idx = top_idx // V
+        tok_idx = (top_idx % V).astype(jnp.int32)
+        new_finished = jnp.take_along_axis(bs.finished, beam_idx, axis=1) | (
+            tok_idx == eos_id)
+        sel_state = _gather_beams(new_state, beam_idx)
+        nbs = BeamState(tok_idx, top_scores, new_finished, sel_state)
+        return nbs, (tok_idx, beam_idx)
+
+    final, (toks, backptrs) = lax.scan(step, init, None, length=max_len)
+    # toks/backptrs: (T, B, K).  Re-walk backpointers (beam_search_decode).
+    def backtrack(carry, tb):
+        ptr = carry  # (B, K) which beam at t+1 each output row follows
+        tok_t, bp_t = tb
+        tok = jnp.take_along_axis(tok_t, ptr, axis=1)
+        new_ptr = jnp.take_along_axis(bp_t, ptr, axis=1)
+        return new_ptr, tok
+
+    init_ptr = jnp.tile(jnp.arange(K, dtype=jnp.int32), (B, 1))
+    _, seq_rev = lax.scan(backtrack, init_ptr, (toks, backptrs), reverse=True)
+    sequences = jnp.moveaxis(seq_rev, 0, 2)  # (B, K, T)
+
+    scores = final.log_probs
+    if length_penalty > 0:
+        lengths = jnp.sum(
+            jnp.cumsum((sequences == eos_id).astype(jnp.int32), axis=-1) == 0,
+            axis=-1) + 1.0
+        scores = scores / jnp.power(lengths, length_penalty)
+    order = jnp.argsort(-scores, axis=1)
+    sequences = jnp.take_along_axis(sequences, order[..., None], axis=1)
+    scores = jnp.take_along_axis(scores, order, axis=1)
+    return sequences, scores
+
+
+def greedy_search(step_fn, init_state, batch_size, bos_id, eos_id, max_len):
+    """Greedy decode: step_fn(tokens (B,), state) -> (logits (B, V), state)."""
+    B = batch_size
+
+    def step(carry, _):
+        tokens, state, finished = carry
+        logits, new_state = step_fn(tokens, state)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(finished, eos_id, nxt)
+        return (nxt, new_state, finished | (nxt == eos_id)), nxt
+
+    init = (jnp.full((B,), bos_id, jnp.int32), init_state, jnp.zeros((B,), bool))
+    _, out = lax.scan(step, init, None, length=max_len)
+    return jnp.moveaxis(out, 0, 1)  # (B, T)

@@ -152,7 +152,11 @@ class Variable:
     ):
         self.block = block
         self.name = name if name is not None else unique_name("_generated_var")
-        self.shape = tuple(int(s) for s in shape) if shape is not None else None
+        # unknown dims may be given as None (normalized to -1)
+        self.shape = (
+            tuple(-1 if s is None else int(s) for s in shape)
+            if shape is not None else None
+        )
         self.dtype = convert_dtype(dtype)
         self.lod_level = lod_level
         self.persistable = persistable

@@ -196,7 +196,16 @@ elementwise_div = _elementwise("elementwise_div")
 def _reduce(op_type):
     def layer(input, dim=0, keep_dim=False, reduce_all=False, **kwargs):
         helper = LayerHelper(op_type, **kwargs)
-        out = helper.create_tmp_variable(input.dtype)
+        shape = None
+        if input.shape is not None:
+            if reduce_all:
+                shape = (1,) * len(input.shape) if keep_dim else ()
+            elif keep_dim:
+                shape = tuple(1 if i == dim else s
+                              for i, s in enumerate(input.shape))
+            else:
+                shape = tuple(s for i, s in enumerate(input.shape) if i != dim)
+        out = helper.create_tmp_variable(input.dtype, shape)
         helper.append_op(type=op_type, inputs={"X": [input]}, outputs={"Out": [out]},
                          attrs={"dim": dim, "keep_dim": keep_dim,
                                 "reduce_all": reduce_all})

@@ -1,0 +1,143 @@
+"""Learning-rate schedules as in-graph sub-programs.
+
+Reference: the legacy LR policies (paddle/parameter/
+LearningRateScheduler.cpp — poly/const/linear/exp/discexp) configured
+via TrainerConfig.  Here each schedule is a small set of ops computing
+lr from a persistable ``global_step`` counter, so the schedule compiles
+into the training step (no host-side LR push per batch as the pserver
+path needed)."""
+
+from __future__ import annotations
+
+from paddle_tpu import framework
+from paddle_tpu.framework import unique_name
+from paddle_tpu.initializer import ConstantInitializer
+from paddle_tpu.layer_helper import LayerHelper
+
+
+def _counter(helper: LayerHelper, step_name="@lr_global_step@"):
+    main = helper.main_program.global_block()
+    if main.has_var(step_name):
+        return main.var(step_name)
+    startup = helper.startup_program.global_block()
+    svar = startup.create_var(name=step_name, shape=(1,), dtype="float32",
+                              persistable=True)
+    ConstantInitializer(0.0)(svar, startup)
+    var = main.create_var(name=step_name, shape=(1,), dtype="float32",
+                          persistable=True)
+    # bump once per executed step
+    main.append_op(type="increment", inputs={"X": [var]},
+                   outputs={"Out": [var]}, attrs={"step": 1.0})
+    return var
+
+
+def _unary_chain(helper, x, ops):
+    """ops: list of (op_type, attrs); threads x through."""
+    for op_type, attrs in ops:
+        out = helper.create_tmp_variable("float32", (1,))
+        helper.append_op(type=op_type, inputs={"X": [x]},
+                         outputs={"Out": [out]}, attrs=attrs)
+        x = out
+    return x
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False, **kwargs):
+    """lr * decay_rate ^ (step / decay_steps)"""
+    helper = LayerHelper("exponential_decay", **kwargs)
+    step = _counter(helper)
+    div = _unary_chain(helper, step, [("scale", {"scale": 1.0 / decay_steps})])
+    if staircase:
+        div = _unary_chain(helper, div, [("floor", {})])
+    import math
+
+    # decay_rate^d = exp(d * ln(decay_rate))
+    lr = _unary_chain(helper, div, [
+        ("scale", {"scale": math.log(decay_rate)}),
+        ("exp", {}),
+        ("scale", {"scale": float(learning_rate)}),
+    ])
+    return lr
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False, **kwargs):
+    """lr * exp(-decay_rate * step / decay_steps)"""
+    helper = LayerHelper("natural_exp_decay", **kwargs)
+    step = _counter(helper)
+    div = _unary_chain(helper, step, [("scale", {"scale": 1.0 / decay_steps})])
+    if staircase:
+        div = _unary_chain(helper, div, [("floor", {})])
+    return _unary_chain(helper, div, [
+        ("scale", {"scale": -float(decay_rate)}),
+        ("exp", {}),
+        ("scale", {"scale": float(learning_rate)}),
+    ])
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False, **kwargs):
+    """lr / (1 + decay_rate * step / decay_steps)"""
+    helper = LayerHelper("inverse_time_decay", **kwargs)
+    step = _counter(helper)
+    div = _unary_chain(helper, step, [("scale", {"scale": 1.0 / decay_steps})])
+    if staircase:
+        div = _unary_chain(helper, div, [("floor", {})])
+    return _unary_chain(helper, div, [
+        ("scale", {"scale": float(decay_rate), "bias": 1.0}),
+        ("reciprocal", {}),
+        ("scale", {"scale": float(learning_rate)}),
+    ])
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
+                     power=1.0, cycle=False, **kwargs):
+    """(lr - end_lr) * (1 - min(step, decay_steps)/decay_steps)^power + end_lr"""
+    helper = LayerHelper("polynomial_decay", **kwargs)
+    step = _counter(helper)
+    frac = _unary_chain(helper, step, [
+        ("scale", {"scale": 1.0 / decay_steps}),
+        ("clip", {"min": 0.0, "max": 1.0}),
+        ("scale", {"scale": -1.0, "bias": 1.0}),
+        ("pow", {"factor": float(power)}),
+        ("scale", {"scale": float(learning_rate - end_learning_rate),
+                   "bias": float(end_learning_rate)}),
+    ])
+    return frac
+
+
+def piecewise_decay(boundaries, values, **kwargs):
+    """Step function: lr = values[i] for step in (boundaries[i-1], boundaries[i]]."""
+    assert len(values) == len(boundaries) + 1
+    helper = LayerHelper("piecewise_decay", **kwargs)
+    step = _counter(helper)
+    # lr = v0 + sum_i (v_{i+1}-v_i) * [step >= b_i], via sigmoid-free compare
+    from paddle_tpu.layers import tensor as tl
+
+    lr = None
+    prev = values[0]
+    acc = helper.create_tmp_variable("float32", (1,))
+    helper.append_op(type="fill_constant", outputs={"Out": [acc]},
+                     attrs={"shape": [1], "dtype": "float32",
+                            "value": float(values[0])})
+    for b, v in zip(boundaries, values[1:]):
+        geq = helper.create_tmp_variable("bool", (1,))
+        bvar = helper.create_tmp_variable("float32", (1,))
+        helper.append_op(type="fill_constant", outputs={"Out": [bvar]},
+                         attrs={"shape": [1], "dtype": "float32",
+                                "value": float(b)})
+        helper.append_op(type="greater_equal", inputs={"X": [step], "Y": [bvar]},
+                         outputs={"Out": [geq]})
+        gf = helper.create_tmp_variable("float32", (1,))
+        helper.append_op(type="cast", inputs={"X": [geq]}, outputs={"Out": [gf]},
+                         attrs={"out_dtype": "float32"})
+        deltav = helper.create_tmp_variable("float32", (1,))
+        helper.append_op(type="scale", inputs={"X": [gf]},
+                         outputs={"Out": [deltav]},
+                         attrs={"scale": float(v - prev)})
+        nacc = helper.create_tmp_variable("float32", (1,))
+        helper.append_op(type="sum", inputs={"X": [acc, deltav]},
+                         outputs={"Out": [nacc]})
+        acc = nacc
+        prev = v
+    return acc

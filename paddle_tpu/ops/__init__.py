@@ -7,6 +7,7 @@ from paddle_tpu.ops import tensor_ops  # noqa: F401
 from paddle_tpu.ops import math_ops  # noqa: F401
 from paddle_tpu.ops import activation_ops  # noqa: F401
 from paddle_tpu.ops import nn_ops  # noqa: F401
+from paddle_tpu.ops import nn_extra_ops  # noqa: F401
 from paddle_tpu.ops import loss_ops  # noqa: F401
 from paddle_tpu.ops import reduce_ops  # noqa: F401
 from paddle_tpu.ops import optimizer_ops  # noqa: F401

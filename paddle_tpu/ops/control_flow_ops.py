@@ -1,13 +1,189 @@
-"""Control-flow-adjacent ops (reference: operators/{is_empty,increment,
-array ops}).  Structured while/cond lowering lives with the layers that
-build sub-blocks; these are the leaf utilities."""
+"""Control-flow ops.
+
+Reference: operators/{while_op.cc:35,92, recurrent_op.cc,
+conditional_block_op.cc, tensor_array_read_write_op.cc,
+lod_array_length_op.cc, increment, is_empty}.
+
+TPU inversion (SURVEY.md §7): the reference interprets sub-blocks with
+nested Executors and per-iteration step scopes; here a sub-block is
+*traced into the parent XLA program* as a ``lax.while_loop`` /
+``lax.scan`` / ``lax.cond`` region.  Loop state = the sub-block's
+written vars that were initialized outside the loop; everything else is
+a per-iteration temp.  ``recurrent`` (StaticRNN) uses lax.scan so the
+whole RNN is reverse-differentiable via the standard vjp replay —
+there is no RecurrentGradientMachine equivalent to hand-maintain.
+"""
 
 from __future__ import annotations
 
 import jax.numpy as jnp
+from jax import lax
 
 from paddle_tpu.lod import unwrap
-from paddle_tpu.registry import register_op
+from paddle_tpu.registry import LowerContext, OpRegistry, register_op
+from paddle_tpu.tensor_array import TensorArray
+
+
+def _run_sub_block(sub_block, values, executor_ctx):
+    for op_ in sub_block.ops:
+        info = OpRegistry.get(op_.type)
+        info.lower(LowerContext(op_, values, rng=None, executor_ctx=executor_ctx))
+
+
+@register_op("while", inputs=("X", "Condition"), outputs=("Out", "StepScopes"),
+             stop_gradient=True)
+def _while(ctx):
+    """lax.while_loop over the sub-block.  Carried state: sub-block
+    written vars that exist (were initialized) before the loop, plus the
+    condition.  Not differentiable (use ``recurrent`` for trainable
+    recurrences) — matching XLA's while semantics."""
+    sub = ctx.attr("sub_block")
+    cond_name = ctx.op.input("Condition")[0]
+    outer = ctx.values
+    written = []
+    for op_ in sub.ops:
+        for n in op_.output_arg_names:
+            if n:
+                written.append(n)
+    carry_names = [cond_name] + [
+        n for n in dict.fromkeys(written) if n in outer and n != cond_name
+    ]
+
+    def cond_fn(carry):
+        return jnp.reshape(unwrap(carry[cond_name]), ()).astype(bool)
+
+    def body_fn(carry):
+        values = dict(outer)
+        values.update(carry)
+        _run_sub_block(sub, values, ctx.executor_ctx)
+        return {n: values[n] for n in carry_names}
+
+    init = {n: outer[n] for n in carry_names}
+    final = lax.while_loop(cond_fn, body_fn, init)
+    for n, v in final.items():
+        outer[n] = v
+
+
+@register_op("recurrent",
+             inputs=("Inputs", "InitStates", "Params"),
+             outputs=("Outputs", "FinalStates"))
+def _recurrent(ctx):
+    """StaticRNN as lax.scan (reference: operators/recurrent_op.cc runs
+    the step block once per time step with linked memories).
+
+    attrs: sub_block, state_names (memory var names read in the block),
+    state_update_names (vars holding each memory's new value),
+    step_input_names (per-step slice var names, aligned with Inputs),
+    step_output_names, reverse.  Sequence inputs are batch-major
+    (B, T, ...); each scan step runs the sub-block on (B, ...) slices —
+    full-batch MXU work per step.  Differentiable via vjp replay (the
+    whole scan is traced, jax handles the backward scan)."""
+    sub = ctx.attr("sub_block")
+    state_names = ctx.attr("state_names")
+    state_update_names = ctx.attr("state_update_names")
+    step_input_names = ctx.attr("step_input_names")
+    step_output_names = ctx.attr("step_output_names")
+    reverse = ctx.attr("reverse", False)
+    outer = ctx.values
+
+    seqs = [unwrap(v) for v in ctx.inputs("Inputs")]
+    xs = tuple(jnp.moveaxis(s, 1, 0) for s in seqs)  # (T, B, ...)
+    init_states = tuple(unwrap(v) for v in ctx.inputs("InitStates"))
+
+    def step(states, xts):
+        values = dict(outer)
+        for n, v in zip(state_names, states):
+            values[n] = v
+        for n, v in zip(step_input_names, xts):
+            values[n] = v
+        _run_sub_block(sub, values, ctx.executor_ctx)
+        new_states = tuple(values[n] for n in state_update_names)
+        outs = tuple(values[n] for n in step_output_names)
+        return new_states, outs
+
+    final_states, outs = lax.scan(step, init_states, xs, reverse=reverse)
+    ctx.set_outputs("Outputs", [jnp.moveaxis(o, 0, 1) for o in outs])
+    if ctx.has_output("FinalStates"):
+        ctx.set_outputs("FinalStates", list(final_states))
+
+
+@register_op("conditional_block", inputs=("Cond", "X"), outputs=("Out", "Scope"))
+def _conditional_block(ctx):
+    """lax.cond over the sub-block given a scalar bool condition.  The
+    false branch passes through the outputs' pre-loop values, so each
+    Out var must be initialized before the op (the reference instead
+    skips execution and leaves vars untouched — same observable
+    semantics)."""
+    sub = ctx.attr("sub_block")
+    cond = jnp.reshape(unwrap(ctx.inputs("Cond")[0]), ()).astype(bool)
+    out_names = [n for n in ctx.op.output("Out") if n]
+    outer = ctx.values
+
+    def true_fn(init):
+        values = dict(outer)
+        values.update(init)
+        _run_sub_block(sub, values, ctx.executor_ctx)
+        return {n: values[n] for n in out_names}
+
+    def false_fn(init):
+        return init
+
+    init = {n: outer[n] for n in out_names}
+    final = lax.cond(cond, true_fn, false_fn, init)
+    for n, v in final.items():
+        outer[n] = v
+
+
+# --- tensor arrays ---------------------------------------------------------
+
+
+@register_op("create_array", inputs=(), stop_gradient=True)
+def _create_array(ctx):
+    shape = tuple(ctx.attr("elem_shape"))
+    cap = ctx.attr("capacity", 64)
+    from paddle_tpu.ops.common import jnp_dtype
+
+    ctx.set_output("Out", TensorArray.create(cap, shape, jnp_dtype(ctx.attr("dtype", "float32"))))
+
+
+@register_op("write_to_array", inputs=("X", "I", "Array"))
+def _write_to_array(ctx):
+    arr = ctx.input("Array")
+    ctx.set_output("Out", arr.write(unwrap(ctx.input("I")), unwrap(ctx.input("X"))))
+
+
+@register_op("read_from_array", inputs=("X", "I"))
+def _read_from_array(ctx):
+    arr = ctx.input("X")
+    ctx.set_output("Out", arr.read(unwrap(ctx.input("I"))))
+
+
+@register_op("lod_array_length", inputs=("X",), stop_gradient=True)
+def _lod_array_length(ctx):
+    ctx.set_output("Out", ctx.input("X").length.reshape(1).astype(jnp.int64))
+
+
+@register_op("max_sequence_len", inputs=("RankTable",), stop_gradient=True)
+def _max_sequence_len(ctx):
+    x = ctx.input("RankTable")
+    from paddle_tpu.lod import LoDArray
+
+    if isinstance(x, LoDArray):
+        ctx.set_output("Out", jnp.max(x.seq_lens()).reshape(()))
+    else:
+        ctx.set_output("Out", jnp.asarray(unwrap(x).shape[1], jnp.int32))
+
+
+@register_op("select_where", inputs=("Cond", "X", "Y"), diff_inputs=("X", "Y"))
+def _select_where(ctx):
+    """Row-wise select: out[i] = cond[i] ? x[i] : y[i] (the IfElse merge;
+    reference analog: operators/merge_lod_tensor_op via mask)."""
+    cond = unwrap(ctx.inputs("Cond")[0]).astype(bool)
+    x = unwrap(ctx.input("X"))
+    y = unwrap(ctx.input("Y"))
+    while cond.ndim < x.ndim:
+        cond = cond[..., None] if cond.ndim else cond.reshape((1,))
+    ctx.set_output("Out", jnp.where(cond, x, y))
 
 
 @register_op("is_empty", inputs=("X",), stop_gradient=True)

@@ -1,0 +1,395 @@
+"""Second-wave NN ops.
+
+Reference: operators/{nce,linear_chain_crf,crf_decoding,roi_pool,
+row_conv,conv_shift,pool_with_index,unpool,pool3d,sampling_id,norm,
+precision_recall}_op.cc.
+
+LoD deviation: the CRF pair operates on padded (B, T, D) emissions plus
+a Length vector (the TPU layout) rather than packed LoD rows; the
+DataFeeder/layers adapt.  Forward/viterbi recursions are lax.scan over
+time — compiled, not per-sequence host loops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.lod import LoDArray, rewrap, row_segment_ids, unwrap
+from paddle_tpu.registry import register_op
+
+NEG_INF = -1e9
+
+
+@register_op("nce", inputs=("Input", "Label", "Weight", "Bias", "SampleWeight"),
+             outputs=("Cost", "SampleLogits", "SampleLabels"),
+             diff_inputs=("Input", "Weight", "Bias"))
+def _nce(ctx):
+    """Noise-contrastive estimation (reference: operators/nce_op.cc;
+    legacy gserver/layers/NCELayer).  Shares negative samples across the
+    batch (drawn uniformly per step), binary logistic loss."""
+    x = unwrap(ctx.input("Input"))           # (B, D)
+    label = unwrap(ctx.input("Label")).astype(jnp.int32)  # (B, T)
+    if label.ndim == 1:
+        label = label[:, None]
+    w = unwrap(ctx.input("Weight"))          # (V, D)
+    num_neg = ctx.attr("num_neg_samples", 10)
+    V = ctx.attr("num_total_classes", w.shape[0])
+    B = x.shape[0]
+    num_true = label.shape[1]
+
+    samples = jax.random.randint(ctx.rng(), (num_neg,), 0, V)
+    ids = jnp.concatenate([label, jnp.tile(samples[None], (B, 1))], axis=1)
+    logits = jnp.einsum("bd,bkd->bk", x, w[ids])
+    if ctx.has_input("Bias"):
+        logits = logits + unwrap(ctx.input("Bias"))[ids]
+    labels01 = jnp.concatenate(
+        [jnp.ones((B, num_true)), jnp.zeros((B, num_neg))], axis=1)
+    # sigmoid CE with noise prior q = 1/V (uniform sampler)
+    logq = jnp.log(jnp.asarray(num_neg / V, jnp.float32))
+    adj = logits - logq
+    loss = jnp.maximum(adj, 0) - adj * labels01 + jnp.log1p(jnp.exp(-jnp.abs(adj)))
+    ctx.set_output("Cost", jnp.sum(loss, axis=1, keepdims=True))
+    ctx.set_output("SampleLogits", logits)
+    ctx.set_output("SampleLabels", ids)
+
+
+def _crf_norm_scan(emission, transition, length):
+    """log-partition per sequence. emission (B,T,D) f32, transition
+    (D+2, D): row 0 start, row 1 end, rows 2.. pairwise. length (B,)."""
+    B, T, D = emission.shape
+    start = transition[0]
+    end = transition[1]
+    pair = transition[2:]                    # (D, D) pair[i, j]: i -> j
+
+    alpha0 = start[None, :] + emission[:, 0]  # (B, D)
+
+    def step(alpha, inputs):
+        e_t, t_idx = inputs                   # (B, D), scalar
+        # logsumexp_i alpha_i + pair[i, j] + e_j
+        s = alpha[:, :, None] + pair[None, :, :]
+        new = jax.scipy.special.logsumexp(s, axis=1) + e_t
+        valid = (t_idx < length)[:, None]
+        alpha = jnp.where(valid, new, alpha)
+        return alpha, alpha
+
+    ts = jnp.arange(1, T)
+    alpha, _ = lax.scan(step, alpha0, (jnp.moveaxis(emission[:, 1:], 1, 0), ts))
+    return jax.scipy.special.logsumexp(alpha + end[None, :], axis=1), alpha0
+
+
+def _crf_path_score(emission, transition, label, length):
+    B, T, D = emission.shape
+    start = transition[0]
+    end = transition[1]
+    pair = transition[2:]
+    lab = label.astype(jnp.int32)
+    if lab.ndim == 3:
+        lab = lab[..., 0]
+    t_range = jnp.arange(T)[None, :]
+    mask = (t_range < length[:, None]).astype(jnp.float32)
+    emit = jnp.take_along_axis(emission, lab[..., None], axis=2)[..., 0]
+    score = jnp.sum(emit * mask, axis=1)
+    score = score + start[lab[:, 0]]
+    trans = pair[lab[:, :-1], lab[:, 1:]]     # (B, T-1)
+    score = score + jnp.sum(trans * mask[:, 1:], axis=1)
+    last_idx = jnp.maximum(length - 1, 0)
+    last_tag = jnp.take_along_axis(lab, last_idx[:, None], axis=1)[:, 0]
+    return score + end[last_tag]
+
+
+@register_op("linear_chain_crf",
+             inputs=("Emission", "Transition", "Label", "Length"),
+             outputs=("LogLikelihood", "Alpha", "EmissionExps", "TransitionExps"),
+             diff_inputs=("Emission", "Transition"))
+def _linear_chain_crf(ctx):
+    em = unwrap(ctx.input("Emission")).astype(jnp.float32)  # (B,T,D)
+    tr = unwrap(ctx.input("Transition")).astype(jnp.float32)
+    label = unwrap(ctx.input("Label"))
+    if ctx.has_input("Length"):
+        length = unwrap(ctx.input("Length")).reshape(-1).astype(jnp.int32)
+    else:
+        length = jnp.full((em.shape[0],), em.shape[1], jnp.int32)
+    logz, alpha0 = _crf_norm_scan(em, tr, length)
+    score = _crf_path_score(em, tr, label, length)
+    ll = (score - logz)[:, None]
+    ctx.set_output("LogLikelihood", -ll)  # reference emits negative LL as cost
+    ctx.set_output("Alpha", alpha0)
+    ctx.set_output("EmissionExps", jnp.exp(em))
+    ctx.set_output("TransitionExps", jnp.exp(tr))
+
+
+@register_op("crf_decoding", inputs=("Emission", "Transition", "Label", "Length"),
+             outputs=("ViterbiPath",), stop_gradient=True)
+def _crf_decoding(ctx):
+    em = unwrap(ctx.input("Emission")).astype(jnp.float32)
+    tr = unwrap(ctx.input("Transition")).astype(jnp.float32)
+    B, T, D = em.shape
+    if ctx.has_input("Length"):
+        length = unwrap(ctx.input("Length")).reshape(-1).astype(jnp.int32)
+    else:
+        length = jnp.full((B,), T, jnp.int32)
+    start, end, pair = tr[0], tr[1], tr[2:]
+
+    delta0 = start[None, :] + em[:, 0]
+
+    def fwd(delta, inputs):
+        e_t, t_idx = inputs
+        s = delta[:, :, None] + pair[None]
+        best = jnp.max(s, axis=1) + e_t
+        arg = jnp.argmax(s, axis=1).astype(jnp.int32)
+        valid = (t_idx < length)[:, None]
+        new_delta = jnp.where(valid, best, delta)
+        return new_delta, arg
+
+    ts = jnp.arange(1, T)
+    delta, backs = lax.scan(fwd, delta0, (jnp.moveaxis(em[:, 1:], 1, 0), ts))
+    last = jnp.argmax(delta + end[None], axis=1).astype(jnp.int32)
+
+    def bwd(tag, inputs):
+        back_t, t_idx = inputs
+        prev = jnp.take_along_axis(back_t, tag[:, None], axis=1)[:, 0]
+        # only follow the pointer for steps inside the sequence
+        tag_new = jnp.where(t_idx < length, prev, tag)
+        return tag_new, tag_new
+
+    _, path_rev = lax.scan(bwd, last, (backs[::-1], ts[::-1]))
+    path = jnp.concatenate([path_rev[::-1].T, last[:, None]], axis=1)  # (B,T)
+    ctx.set_output("ViterbiPath", path)
+
+
+@register_op("roi_pool", inputs=("X", "ROIs"), outputs=("Out", "Argmax"),
+             diff_inputs=("X",))
+def _roi_pool(ctx):
+    """Max-pool fixed bins over regions (reference: operators/roi_pool_op.cc).
+    ROIs: (R, 5) [batch_idx, x1, y1, x2, y2]."""
+    x = unwrap(ctx.input("X"))        # (B, C, H, W)
+    rois = unwrap(ctx.input("ROIs")).astype(jnp.float32)
+    ph = ctx.attr("pooled_height", 1)
+    pw = ctx.attr("pooled_width", 1)
+    scale = ctx.attr("spatial_scale", 1.0)
+    B, C, H, W = x.shape
+
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs_ = jnp.arange(W, dtype=jnp.float32)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * scale, roi[2] * scale, roi[3] * scale, roi[4] * scale
+        rh = jnp.maximum(y2 - y1 + 1, 1.0) / ph
+        rw = jnp.maximum(x2 - x1 + 1, 1.0) / pw
+        fmap = x[b]                    # (C, H, W)
+
+        def bin_val(i, j):
+            ys_lo = y1 + i * rh
+            ys_hi = y1 + (i + 1) * rh
+            xs_lo = x1 + j * rw
+            xs_hi = x1 + (j + 1) * rw
+            m = ((ys >= jnp.floor(ys_lo)) & (ys < jnp.ceil(ys_hi)))[:, None] & \
+                ((xs_ >= jnp.floor(xs_lo)) & (xs_ < jnp.ceil(xs_hi)))[None, :]
+            masked = jnp.where(m[None], fmap, NEG_INF)
+            return jnp.max(masked, axis=(1, 2))
+
+        grid = jnp.stack([jnp.stack([bin_val(i, j) for j in range(pw)], -1)
+                          for i in range(ph)], -2)   # (C, ph, pw)
+        return grid
+
+    out = jax.vmap(one_roi)(rois)     # (R, C, ph, pw)
+    ctx.set_output("Out", out.astype(x.dtype))
+    if ctx.has_output("Argmax"):
+        ctx.set_output("Argmax", jnp.zeros(out.shape, jnp.int32))
+
+
+@register_op("row_conv", inputs=("X", "Filter"), diff_inputs=("X", "Filter"))
+def _row_conv(ctx):
+    """Lookahead row convolution (reference: operators/row_conv_op.cc):
+    out[t] = sum_{i=0..k-1} w[i] * x[t+i], over (B, T, D) input."""
+    x = unwrap(ctx.input("X"))
+    w = unwrap(ctx.input("Filter"))    # (k, D)
+    k = w.shape[0]
+    B, T, D = x.shape
+    pad = jnp.pad(x, ((0, 0), (0, k - 1), (0, 0)))
+    out = sum(pad[:, i:i + T] * w[i][None, None, :] for i in range(k))
+    ctx.set_output("Out", out)
+
+
+@register_op("conv_shift", inputs=("X", "Y"), diff_inputs=("X", "Y"))
+def _conv_shift(ctx):
+    """Circular correlation (reference: operators/conv_shift_op.cc):
+    out[b, i] = sum_j x[b, (i + j - M/2) mod N] * y[b, j]."""
+    x = unwrap(ctx.input("X"))  # (B, N)
+    y = unwrap(ctx.input("Y"))  # (B, M), M odd
+    B, N = x.shape
+    M = y.shape[1]
+    half = M // 2
+    idx = (jnp.arange(N)[:, None] + jnp.arange(M)[None, :] - half) % N  # (N, M)
+    ctx.set_output("Out", jnp.einsum("bnm,bm->bn", x[:, idx], y))
+
+
+@register_op("max_pool2d_with_index", inputs=("X",), outputs=("Out", "Mask"))
+def _max_pool2d_with_index(ctx):
+    x = unwrap(ctx.input("X"))
+    ks = tuple(ctx.attr("ksize", (2, 2)))
+    st = tuple(ctx.attr("strides", (2, 2)))
+    pd = tuple(ctx.attr("paddings", (0, 0)))
+    if ctx.attr("global_pooling", False):
+        ks, st, pd = x.shape[2:4], (1, 1), (0, 0)
+    B, C, H, W = x.shape
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=ks, window_strides=st,
+        padding=[(pd[0], pd[0]), (pd[1], pd[1])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    OH, OW = patches.shape[2], patches.shape[3]
+    patches = patches.reshape(B, C, ks[0] * ks[1], OH, OW)
+    out = jnp.max(patches, axis=2)
+    within = jnp.argmax(patches, axis=2).astype(jnp.int32)  # window-local idx
+    # convert to global flat H*W index, matching the reference Mask
+    oy = jnp.arange(OH)[:, None] * st[0] - pd[0]
+    ox = jnp.arange(OW)[None, :] * st[1] - pd[1]
+    wy = within // ks[1]
+    wx = within % ks[1]
+    gy = jnp.clip(oy[None, None] + wy, 0, H - 1)
+    gx = jnp.clip(ox[None, None] + wx, 0, W - 1)
+    ctx.set_output("Out", out)
+    ctx.set_output("Mask", gy * W + gx)
+
+
+@register_op("unpool", inputs=("X", "Indices"), diff_inputs=("X",))
+def _unpool(ctx):
+    """Max-unpool via the Mask indices (reference: operators/unpool_op.cc)."""
+    x = unwrap(ctx.input("X"))           # (B, C, h, w)
+    idx = unwrap(ctx.input("Indices")).astype(jnp.int32)
+    ks = tuple(ctx.attr("ksize", (2, 2)))
+    st = tuple(ctx.attr("strides", ks))
+    B, C, h, w = x.shape
+    H = (h - 1) * st[0] + ks[0]
+    W = (w - 1) * st[1] + ks[1]
+    flat = jnp.zeros((B, C, H * W), x.dtype)
+    out = flat.at[
+        jnp.arange(B)[:, None, None],
+        jnp.arange(C)[None, :, None],
+        idx.reshape(B, C, -1),
+    ].add(x.reshape(B, C, -1))
+    ctx.set_output("Out", out.reshape(B, C, H, W))
+
+
+@register_op("pool3d", inputs=("X",))
+def _pool3d(ctx):
+    x = unwrap(ctx.input("X"))
+    ks = tuple(ctx.attr("ksize", (2, 2, 2)))
+    st = tuple(ctx.attr("strides", (1, 1, 1)))
+    pd = tuple(ctx.attr("paddings", (0, 0, 0)))
+    if ctx.attr("global_pooling", False):
+        ks, st, pd = x.shape[2:5], (1, 1, 1), (0, 0, 0)
+    window = (1, 1) + ks
+    strides = (1, 1) + st
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+    if ctx.attr("pooling_type", "max") == "max":
+        out = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, padding)
+    else:
+        s = lax.reduce_window(x.astype(jnp.float32), 0.0, lax.add, window,
+                              strides, padding)
+        out = (s / (ks[0] * ks[1] * ks[2])).astype(x.dtype)
+    ctx.set_output("Out", out)
+
+
+@register_op("sampling_id", inputs=("X",), stop_gradient=True)
+def _sampling_id(ctx):
+    probs = unwrap(ctx.input("X"))
+    ctx.set_output("Out", jax.random.categorical(
+        ctx.rng(), jnp.log(probs + 1e-12), axis=-1).astype(jnp.int64))
+
+
+@register_op("norm", inputs=("X", "Scale"), diff_inputs=("X", "Scale"))
+def _norm(ctx):
+    """Cross-channel L2 norm + per-channel scale (reference:
+    operators/norm_op.cc, the SSD NormLayer)."""
+    x = unwrap(ctx.input("X"))  # (B, C, H, W)
+    scale = unwrap(ctx.input("Scale")).reshape(1, -1, 1, 1)
+    eps = ctx.attr("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=1, keepdims=True) + eps)
+    ctx.set_output("Out", x / norm * scale)
+
+
+@register_op("precision_recall", inputs=("MaxProbs", "Indices", "Labels", "Weights"),
+             outputs=("BatchMetrics", "AccumMetrics", "AccumStatesInfo"),
+             stop_gradient=True)
+def _precision_recall(ctx):
+    """Macro/micro precision-recall-F1 over a batch (reference:
+    operators/precision_recall_op.cc)."""
+    idx = unwrap(ctx.input("Indices")).reshape(-1).astype(jnp.int32)
+    labels = unwrap(ctx.input("Labels")).reshape(-1).astype(jnp.int32)
+    C = ctx.attr("class_number")
+    pred_oh = jax.nn.one_hot(idx, C)
+    lab_oh = jax.nn.one_hot(labels, C)
+    tp = jnp.sum(pred_oh * lab_oh, axis=0)
+    fp = jnp.sum(pred_oh * (1 - lab_oh), axis=0)
+    fn = jnp.sum((1 - pred_oh) * lab_oh, axis=0)
+    prec = tp / jnp.maximum(tp + fp, 1e-12)
+    rec = tp / jnp.maximum(tp + fn, 1e-12)
+    f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-12)
+    macro = jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+    tp_s, fp_s, fn_s = jnp.sum(tp), jnp.sum(fp), jnp.sum(fn)
+    mprec = tp_s / jnp.maximum(tp_s + fp_s, 1e-12)
+    mrec = tp_s / jnp.maximum(tp_s + fn_s, 1e-12)
+    mf1 = 2 * mprec * mrec / jnp.maximum(mprec + mrec, 1e-12)
+    micro = jnp.stack([mprec, mrec, mf1])
+    metrics = jnp.concatenate([macro, micro])
+    ctx.set_output("BatchMetrics", metrics)
+    ctx.set_output("AccumMetrics", metrics)
+    ctx.set_output("AccumStatesInfo", jnp.stack([tp, fp, fn], axis=1))
+
+
+@register_op("sequence_conv", inputs=("X", "Filter", "PaddingData"),
+             diff_inputs=("X", "Filter"))
+def _sequence_conv(ctx):
+    """Context-window projection over packed LoD rows with per-sequence
+    boundary masking (reference: operators/sequence_conv_op.cc +
+    math/context_project.h)."""
+    x = ctx.input("X")
+    assert isinstance(x, LoDArray), "sequence_conv needs LoD input"
+    w = unwrap(ctx.input("Filter"))          # (ctx_len * D, M)
+    ctx_len = ctx.attr("contextLength", 3)
+    ctx_start = ctx.attr("contextStart", -(ctx_len // 2))
+    data = x.data                            # (N, D)
+    N, D = data.shape
+    off = x.last_level()
+    ids = row_segment_ids(off, N)
+    cols = []
+    rows_idx = jnp.arange(N)
+    for i in range(ctx_len):
+        shift = ctx_start + i
+        src = jnp.clip(rows_idx + shift, 0, N - 1)
+        col = data[src]
+        # zero out rows that crossed a sequence boundary
+        same_seq = (ids[src] == ids) & ((rows_idx + shift >= 0) & (rows_idx + shift < N))
+        cols.append(jnp.where(same_seq[:, None], col, 0.0))
+    ctx_mat = jnp.concatenate(cols, axis=1)  # (N, ctx_len*D)
+    out = jnp.dot(ctx_mat, w)
+    ctx.set_output("Out", LoDArray(out, x.lod))
+
+
+@register_op("sequence_slice", inputs=("X", "Offset", "Length"),
+             diff_inputs=("X",))
+def _sequence_slice(ctx):
+    """Slice each sequence [offset, offset+length) — rows re-packed with
+    a fresh LoD (reference: operators/sequence_slice_op.cc).  Keeps the
+    packed buffer size (static shapes); invalid rows zeroed."""
+    x = ctx.input("X")
+    assert isinstance(x, LoDArray)
+    offset = unwrap(ctx.input("Offset")).reshape(-1).astype(jnp.int32)
+    length = unwrap(ctx.input("Length")).reshape(-1).astype(jnp.int32)
+    off = x.last_level()
+    N = x.data.shape[0]
+    ids = row_segment_ids(off, N)
+    # position of each row within its sequence
+    pos = jnp.arange(N, dtype=jnp.int32) - off[:-1][jnp.clip(ids, 0, off.shape[0] - 2)]
+    keep = (pos >= offset[jnp.clip(ids, 0, offset.shape[0] - 1)]) & (
+        pos < offset[jnp.clip(ids, 0, offset.shape[0] - 1)]
+        + length[jnp.clip(ids, 0, length.shape[0] - 1)])
+    # stable-compact kept rows to the front
+    order = jnp.argsort(jnp.where(keep, jnp.arange(N), N + jnp.arange(N)))
+    new_data = jnp.where(keep[order][:, None], x.data[order], 0.0)
+    new_off = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(length)])
+    ctx.set_output("Out", LoDArray(new_data, (new_off,)))

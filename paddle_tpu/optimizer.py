@@ -20,7 +20,8 @@ class Optimizer:
     _accumulator_defs: Tuple = ()  # (name, fill_value, like_param?)
 
     def __init__(self, learning_rate: float = 0.01, global_step=None,
-                 regularization=None):
+                 regularization=None, grad_clip=None):
+        self.grad_clip = grad_clip
         self._lr_value = learning_rate
         self._lr_var: Optional[Variable] = None
         self._global_step = global_step
@@ -38,6 +39,10 @@ class Optimizer:
 
     def _create_lr_var(self, block: Block):
         if self._lr_var is not None:
+            return self._lr_var
+        if isinstance(self._lr_value, Variable):
+            # scheduled LR computed in-graph (lr_scheduler.py)
+            self._lr_var = self._lr_value
             return self._lr_var
         name = unique_name("learning_rate")
         startup = self._startup_block()
@@ -89,6 +94,8 @@ class Optimizer:
     def _create_optimization_pass(self, params_grads, loss: Variable):
         block = loss.block.program.global_block()
         self._main_block = block
+        if self.grad_clip is not None:
+            params_grads = self.grad_clip.append_clip_ops(block, params_grads)
         self._create_lr_var(block)
         self._create_accumulators(block, [p for p, _ in params_grads])
         ops = []

@@ -1,0 +1,54 @@
+"""TensorArray: the LoDTensorArray equivalent under a static-shape
+compiler (reference: framework/lod_tensor_array.h, operators/
+tensor_array_read_write_op.cc).
+
+A pytree of (stack, length): ``stack`` is a dense (capacity, ...) buffer,
+``length`` an int32 scalar.  Writes are lax.dynamic_update_slice at a
+traced index, so arrays live inside while-loops/scans without dynamic
+shapes."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.tree_util.register_pytree_node_class
+class TensorArray:
+    def __init__(self, stack, length):
+        self.stack = stack
+        self.length = length
+
+    def tree_flatten(self):
+        return (self.stack, self.length), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # -- api ----------------------------------------------------------------
+
+    @classmethod
+    def create(cls, capacity: int, elem_shape, dtype=jnp.float32) -> "TensorArray":
+        return cls(jnp.zeros((capacity,) + tuple(elem_shape), dtype),
+                   jnp.asarray(0, jnp.int32))
+
+    def write(self, index, value) -> "TensorArray":
+        idx = jnp.asarray(index, jnp.int32).reshape(())
+        stack = lax.dynamic_update_slice(
+            self.stack, value[None], (idx,) + (0,) * value.ndim)
+        return TensorArray(stack, jnp.maximum(self.length, idx + 1))
+
+    def read(self, index):
+        idx = jnp.asarray(index, jnp.int32).reshape(())
+        return lax.dynamic_slice(
+            self.stack, (idx,) + (0,) * (self.stack.ndim - 1),
+            (1,) + self.stack.shape[1:])[0]
+
+    @property
+    def capacity(self):
+        return self.stack.shape[0]
+
+    def __repr__(self):
+        return f"TensorArray(capacity={self.capacity}, elem={self.stack.shape[1:]})"

@@ -1,0 +1,134 @@
+"""Control-flow tests: While -> lax.while_loop, StaticRNN -> lax.scan,
+IfElse select semantics, tensor arrays (reference model: fluid tests
+test_while_op.py / test_recurrent_op.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_while_accumulate(rng):
+    """sum 0..9 with a While loop over scalar state."""
+    i = layers.fill_constant(shape=(1,), dtype="float32", value=0.0)
+    n = layers.fill_constant(shape=(1,), dtype="float32", value=10.0)
+    acc = layers.fill_constant(shape=(1,), dtype="float32", value=0.0)
+    cond = layers.less_than(i, n)
+    w = layers.While(cond)
+    with w.block():
+        new_acc = layers.elementwise_add(x=acc, y=i)
+        layers.assign(new_acc, output=acc)
+        layers.increment(i, value=1.0, in_place=True)
+        nc = layers.less_than(i, n)
+        layers.assign(nc, output=cond)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (out,) = exe.run(feed={}, fetch_list=[acc])
+    assert float(out[0]) == sum(range(10)), out
+
+
+def test_while_with_tensor_array(rng):
+    """write i^2 into a TensorArray inside a While, then read one back."""
+    i = layers.fill_constant(shape=(1,), dtype="float32", value=0.0)
+    n = layers.fill_constant(shape=(1,), dtype="float32", value=5.0)
+    arr = layers.create_array("float32", elem_shape=(1,), capacity=8)
+    cond = layers.less_than(i, n)
+    w = layers.While(cond)
+    with w.block():
+        sq = layers.elementwise_mul(x=i, y=i)
+        layers.array_write(sq, i, arr)
+        layers.increment(i, value=1.0, in_place=True)
+        layers.assign(layers.less_than(i, n), output=cond)
+    three = layers.fill_constant(shape=(1,), dtype="int32", value=3)
+    got = layers.array_read(arr, three)
+    length = layers.array_length(arr)
+    exe = fluid.Executor(fluid.CPUPlace())
+    g, ln = exe.run(feed={}, fetch_list=[got, length])
+    assert float(g[0]) == 9.0
+    assert int(ln[0]) == 5
+
+
+def test_static_rnn_matches_manual_scan(rng):
+    """h_t = tanh(x_t W + h_{t-1} U); compare against numpy loop."""
+    B, T, D, H = 3, 4, 5, 6
+    x = layers.data(name="x", shape=[T, D], dtype="float32",
+                    append_batch_size=True)
+    # weights as data for exactness
+    w = layers.data(name="w", shape=[D, H], dtype="float32",
+                    append_batch_size=False)
+    u = layers.data(name="u", shape=[H, H], dtype="float32",
+                    append_batch_size=False)
+
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        h = rnn.memory(batch_ref=x_t, shape=[-1, H], init_value=0.0)
+        xw = layers.matmul(x_t, w)
+        hu = layers.matmul(h, u)
+        s = layers.elementwise_add(x=xw, y=hu)
+        new_h = layers.tanh(s)
+        rnn.update_memory(h, new_h)
+        rnn.step_output(new_h)
+    (out,) = rnn()
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs = rng.randn(B, T, D).astype("float32")
+    ws = (rng.randn(D, H) * 0.3).astype("float32")
+    us = (rng.randn(H, H) * 0.3).astype("float32")
+    (got,) = exe.run(feed={"x": xs, "w": ws, "u": us}, fetch_list=[out])
+
+    h = np.zeros((B, H), np.float32)
+    want = np.zeros((B, T, H), np.float32)
+    for t in range(T):
+        h = np.tanh(xs[:, t] @ ws + h @ us)
+        want[:, t] = h
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-4)
+
+
+def test_static_rnn_trains(rng):
+    """Gradients flow through the recurrent op (scan vjp) into an fc
+    parameter used inside the step block."""
+    B, T, D, H = 4, 5, 3, 8
+    x = layers.data(name="x", shape=[T, D], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="float32")
+    rnn = layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        h = rnn.memory(batch_ref=x_t, shape=[-1, H], init_value=0.0)
+        nh = layers.fc(input=[x_t, h], size=H, act="tanh", bias_attr=False)
+        rnn.update_memory(h, nh)
+        rnn.step_output(nh)
+    (seq_out,) = rnn()
+    last = layers.reduce_mean(seq_out, dim=1)
+    pred = layers.fc(input=last, size=1)
+    loss = layers.mean(layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    first = last_l = None
+    for i in range(60):
+        xs = rng.randn(B, T, D).astype("float32")
+        ys = xs.sum(axis=(1, 2), keepdims=False).reshape(-1, 1).astype("float32") * 0.1
+        (l,) = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+        if first is None:
+            first = float(l)
+        last_l = float(l)
+    assert last_l < 0.7 * first, (first, last_l)
+
+
+def test_ifelse_select(rng):
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    zero = layers.fill_constant_batch_size_like(x, [-1, 1], "float32", 0.0)
+    row_sum = layers.reduce_sum(x, dim=1, keep_dim=True)
+    cond = layers.less_than(row_sum, zero)  # (B, 1) bool
+    ie = layers.IfElse(cond)
+    with ie.true_block():
+        ie.output(layers.scale(x, scale=-1.0))
+    with ie.false_block():
+        ie.output(x)
+    (out,) = ie()
+    exe = fluid.Executor(fluid.CPUPlace())
+    xs = rng.randn(6, 4).astype("float32")
+    (got,) = exe.run(feed={"x": xs}, fetch_list=[out])
+    want = np.where(xs.sum(1, keepdims=True) < 0, -xs, xs)
+    np.testing.assert_allclose(got, want, atol=1e-6)

@@ -1,0 +1,248 @@
+"""Tests for the second op wave: CRF, row_conv, conv_shift, pooling
+variants, precision_recall, sequence_conv, LR schedules, grad clip."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import create_lod_array
+from tests.op_test import OpTest
+
+
+class TestLinearChainCRF(OpTest):
+    op_type = "linear_chain_crf"
+
+    def _np_crf_nll(self, em, tr, lab, lens):
+        """brute-force: -log p(path) over all paths."""
+        B, T, D = em.shape
+        start, end, pair = tr[0], tr[1], tr[2:]
+        out = np.zeros((B, 1), np.float64)
+        import itertools
+
+        for b in range(B):
+            L = lens[b]
+            def path_score(path):
+                s = start[path[0]] + em[b, 0, path[0]]
+                for t in range(1, L):
+                    s += pair[path[t - 1], path[t]] + em[b, t, path[t]]
+                return s + end[path[L - 1]]
+            scores = [path_score(p) for p in itertools.product(range(D), repeat=L)]
+            logz = np.log(np.sum(np.exp(np.asarray(scores))))
+            out[b, 0] = -(path_score(lab[b, :L]) - logz)
+        return out
+
+    def test_matches_bruteforce(self, rng):
+        B, T, D = 3, 4, 3
+        em = rng.randn(B, T, D).astype("float32")
+        tr = (rng.randn(D + 2, D) * 0.5).astype("float32")
+        lens = np.array([4, 3, 2], np.int32)
+        lab = rng.randint(0, D, (B, T)).astype("int64")
+        want = self._np_crf_nll(em.astype(np.float64), tr.astype(np.float64),
+                                lab, lens)
+        self.check_output(
+            {"Emission": [("em", em)], "Transition": [("tr", tr)],
+             "Label": [("lab", lab)], "Length": [("len", lens)]},
+            {},
+            {"LogLikelihood": want.astype(np.float32)},
+            atol=1e-3, rtol=1e-3)
+
+    def test_grad(self, rng):
+        B, T, D = 2, 3, 3
+        em = rng.randn(B, T, D).astype("float32")
+        tr = (rng.randn(D + 2, D) * 0.5).astype("float32")
+        lens = np.array([3, 2], np.int32)
+        lab = rng.randint(0, D, (B, T)).astype("int64")
+        self.check_grad(
+            {"Emission": [("em", em)], "Transition": [("tr", tr)],
+             "Label": [("lab", lab)], "Length": [("len", lens)]},
+            {}, ["LogLikelihood"], wrt=["em", "tr"], loss_slot="LogLikelihood",
+            atol=5e-2, rtol=5e-2)
+
+
+class TestCRFDecoding(OpTest):
+    op_type = "crf_decoding"
+
+    def test_viterbi_matches_bruteforce(self, rng):
+        B, T, D = 2, 4, 3
+        em = rng.randn(B, T, D).astype("float32")
+        tr = (rng.randn(D + 2, D)).astype("float32")
+        lens = np.array([4, 4], np.int32)
+        import itertools
+
+        start, end, pair = tr[0], tr[1], tr[2:]
+        want = np.zeros((B, T), np.int64)
+        for b in range(B):
+            best, best_p = -1e18, None
+            for p in itertools.product(range(D), repeat=T):
+                s = start[p[0]] + em[b, 0, p[0]]
+                for t in range(1, T):
+                    s += pair[p[t - 1], p[t]] + em[b, t, p[t]]
+                s += end[p[T - 1]]
+                if s > best:
+                    best, best_p = s, p
+            want[b] = best_p
+        self.check_output(
+            {"Emission": [("em", em)], "Transition": [("tr", tr)],
+             "Label": [("lab", np.zeros((B, T), "int64"))],
+             "Length": [("len", lens)]},
+            {}, {"ViterbiPath": want}, atol=0, rtol=0,
+            output_meta={"ViterbiPath": {"dtype": "int64"}})
+
+
+class TestRowConv(OpTest):
+    op_type = "row_conv"
+
+    def test_output(self, rng):
+        B, T, D, k = 2, 5, 3, 2
+        x = rng.randn(B, T, D).astype("float32")
+        w = rng.randn(k, D).astype("float32")
+        want = np.zeros_like(x)
+        for t in range(T):
+            for i in range(k):
+                if t + i < T:
+                    want[:, t] += x[:, t + i] * w[i]
+        self.check_output({"X": [("x", x)], "Filter": [("w", w)]}, {},
+                          {"Out": want}, atol=1e-5)
+
+
+class TestConvShift(OpTest):
+    op_type = "conv_shift"
+
+    def test_output(self, rng):
+        B, N, M = 2, 7, 3
+        x = rng.randn(B, N).astype("float32")
+        y = rng.randn(B, M).astype("float32")
+        half = M // 2
+        want = np.zeros_like(x)
+        for b in range(B):
+            for i in range(N):
+                for j in range(M):
+                    want[b, i] += x[b, (i + j - half) % N] * y[b, j]
+        self.check_output({"X": [("x", x)], "Y": [("y", y)]}, {},
+                          {"Out": want}, atol=1e-5)
+
+
+class TestMaxPoolWithIndexUnpool(OpTest):
+    def test_roundtrip(self, rng):
+        import paddle_tpu.framework as framework
+
+        framework.reset_default_programs()
+        prog = fluid.default_main_program()
+        block = prog.global_block()
+        x = rng.randn(2, 3, 4, 4).astype("float32")
+        block.create_var(name="x", shape=x.shape, dtype="float32")
+        for name, shape, dtype in [("out", (2, 3, 2, 2), "float32"),
+                                   ("mask", (2, 3, 2, 2), "int32"),
+                                   ("rec", (2, 3, 4, 4), "float32")]:
+            block.create_var(name=name, shape=shape, dtype=dtype)
+        block.append_op(type="max_pool2d_with_index", inputs={"X": ["x"]},
+                        outputs={"Out": ["out"], "Mask": ["mask"]},
+                        attrs={"ksize": [2, 2], "strides": [2, 2],
+                               "paddings": [0, 0]})
+        block.append_op(type="unpool", inputs={"X": ["out"], "Indices": ["mask"]},
+                        outputs={"Out": ["rec"]},
+                        attrs={"ksize": [2, 2], "strides": [2, 2]})
+        exe = fluid.Executor(fluid.CPUPlace())
+        out, mask, rec = exe.run(prog, feed={"x": x},
+                                 fetch_list=["out", "mask", "rec"])
+        want = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5))
+        np.testing.assert_allclose(out, want, atol=1e-6)
+        # unpooled: each max value lands at its Mask position, zeros elsewhere
+        assert rec.sum() == pytest.approx(out.sum(), rel=1e-5)
+        rec_flat = rec.reshape(2, 3, -1)
+        for b in range(2):
+            for c in range(3):
+                for k in range(4):
+                    pos = mask.reshape(2, 3, -1)[b, c, k]
+                    np.testing.assert_allclose(
+                        rec_flat[b, c, pos], out.reshape(2, 3, -1)[b, c, k],
+                        atol=1e-6)
+
+
+class TestPrecisionRecall(OpTest):
+    op_type = "precision_recall"
+
+    def test_micro_macro(self, rng):
+        idx = np.array([0, 1, 1, 2, 2, 2], "int64").reshape(-1, 1)
+        lab = np.array([0, 1, 2, 2, 2, 0], "int64").reshape(-1, 1)
+        # manual: tp per class: c0:1, c1:1, c2:2
+        outs = self.build_and_run(
+            {"MaxProbs": [("p", np.ones((6, 1), "float32"))],
+             "Indices": [("i", idx)], "Labels": [("l", lab)]},
+            {"class_number": 3},
+            ["BatchMetrics"])
+        m = np.asarray(outs[0])
+        # micro precision = recall = 4/6
+        np.testing.assert_allclose(m[3], 4 / 6, atol=1e-6)
+        np.testing.assert_allclose(m[4], 4 / 6, atol=1e-6)
+
+
+class TestSequenceConv(OpTest):
+    op_type = "sequence_conv"
+
+    def test_boundary_masking(self, rng):
+        D, M = 3, 4
+        data = rng.randn(5, D).astype("float32")
+        x = create_lod_array(data, [[0, 2, 5]])
+        w = rng.randn(3 * D, M).astype("float32")
+        outs = self.build_and_run(
+            {"X": [("x", x)], "Filter": [("w", w)]},
+            {"contextLength": 3, "contextStart": -1},
+            ["Out"])
+        got = np.asarray(outs[0].data)
+        # manual context windows respecting boundaries [0,2) and [2,5)
+        want = np.zeros((5, M), np.float32)
+        bounds = [(0, 2), (2, 5)]
+        for lo, hi in bounds:
+            for t in range(lo, hi):
+                ctx = []
+                for sh in (-1, 0, 1):
+                    s = t + sh
+                    ctx.append(data[s] if lo <= s < hi else np.zeros(D, np.float32))
+                want[t] = np.concatenate(ctx) @ w
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_exponential_decay_schedule(rng):
+    import paddle_tpu.lr_scheduler as lrs
+
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1, bias_attr=False)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(input=pred, label=y))
+    lr = lrs.exponential_decay(0.1, decay_steps=10, decay_rate=0.5)
+    fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    lrs_seen = []
+    for i in range(21):
+        xs = rng.randn(4, 4).astype("float32")
+        ys = rng.randn(4, 1).astype("float32")
+        (lv,) = exe.run(feed={"x": xs, "y": ys}, fetch_list=[lr])
+        lrs_seen.append(float(np.asarray(lv).reshape(-1)[0]))
+    # step counter increments each run: lr = 0.1 * 0.5^(step/10)
+    np.testing.assert_allclose(lrs_seen[0], 0.1 * 0.5 ** (1 / 10), rtol=1e-4)
+    np.testing.assert_allclose(lrs_seen[20], 0.1 * 0.5 ** (21 / 10), rtol=1e-4)
+
+
+def test_global_norm_clip(rng):
+    from paddle_tpu.clip import GradientClipByGlobalNorm
+
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1, bias_attr=False)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(input=pred, label=y))
+    opt = fluid.optimizer.SGD(learning_rate=1.0,
+                              grad_clip=GradientClipByGlobalNorm(1e-3))
+    opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = fluid.global_scope()
+    pname = fluid.default_main_program().all_parameters()[0].name
+    w0 = np.asarray(scope.get(pname)).copy()
+    xs = (rng.randn(8, 4) * 100).astype("float32")
+    ys = rng.randn(8, 1).astype("float32")
+    exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+    w1 = np.asarray(scope.get(pname))
+    # update magnitude bounded by lr * clip_norm
+    assert np.linalg.norm(w1 - w0) <= 1e-3 + 1e-6
