@@ -115,6 +115,38 @@ def _lod_reset(ctx):
     ctx.set_output("Out", LoDArray(data, (target,)))
 
 
+@register_op("padded_sequence_pool", inputs=("X", "Length"))
+def _padded_sequence_pool(ctx):
+    """Masked pooling over padded (B, T, D) sequences with lengths (B,)
+    — the dense-layout twin of sequence_pool for the v2 facade."""
+    x = unwrap(ctx.input("X"))          # (B, T, D) or (B, T)
+    lens = unwrap(ctx.input("Length")).reshape(-1).astype(jnp.int32)
+    ptype = ctx.attr("pooltype", "AVERAGE").upper()
+    B, T = x.shape[0], x.shape[1]
+    mask = (jnp.arange(T)[None, :] < lens[:, None])  # (B, T)
+    m = mask.reshape(mask.shape + (1,) * (x.ndim - 2)).astype(x.dtype)
+    if ptype == "SUM":
+        out = jnp.sum(x * m, axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.sum(x * m, axis=1) / jnp.maximum(
+            lens.astype(x.dtype), 1).reshape(-1, *([1] * (x.ndim - 2)))
+    elif ptype == "SQRT":
+        out = jnp.sum(x * m, axis=1) / jnp.sqrt(
+            jnp.maximum(lens.astype(x.dtype), 1)).reshape(-1, *([1] * (x.ndim - 2)))
+    elif ptype == "MAX":
+        neg = jnp.asarray(-1e9, x.dtype)
+        out = jnp.max(jnp.where(mask.reshape(m.shape).astype(bool), x, neg), axis=1)
+    elif ptype == "LAST":
+        idx = jnp.maximum(lens - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx.reshape(-1, 1, *([1] * (x.ndim - 2))), axis=1)[:, 0]
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError(ptype)
+    ctx.set_output("Out", out)
+
+
 @register_op("lstm",
              inputs=("Input", "H0", "C0", "Weight", "Bias"),
              outputs=("Hidden", "Cell", "BatchGate", "BatchCellPreAct"),

@@ -1,0 +1,43 @@
+"""paddle.v2-compatible API facade (reference: python/paddle/v2/).
+
+The reference v2 API compiles layer configs to a ModelConfig proto
+executed by the C++ GradientMachine (SURVEY.md §3.1).  Here v2 layer
+objects are a thin declarative shell that lazily builds a fluid-style
+Program on the TPU core — same user surface, compiled execution.
+
+Sequences: the reference feeds ragged LoD batches; this facade feeds
+dense padded (B, T) batches plus a ``<name>@len`` length vector (the
+TPU layout), produced automatically by the v2 DataFeeder for
+``*_sequence`` data types.
+"""
+
+from paddle_tpu.v2 import activation
+from paddle_tpu.v2 import attr
+from paddle_tpu.v2 import data_type
+from paddle_tpu.v2 import dataset
+from paddle_tpu.v2 import event
+from paddle_tpu.v2 import image
+from paddle_tpu.v2 import inference
+from paddle_tpu.v2 import layer
+from paddle_tpu.v2 import minibatch
+from paddle_tpu.v2 import networks
+from paddle_tpu.v2 import optimizer
+from paddle_tpu.v2 import parameters
+from paddle_tpu.v2 import pooling
+from paddle_tpu.v2 import reader
+from paddle_tpu.v2 import trainer
+from paddle_tpu.v2.inference import infer
+from paddle_tpu.v2.minibatch import batch
+
+_initialized = False
+
+
+def init(use_gpu: bool = False, trainer_count: int = 1, **kwargs):
+    """Process init (reference: paddle.v2.init -> swig initPaddle).
+    Accepted for compatibility; device selection happens via
+    jax/Executor places.  ``use_gpu`` maps to the accelerator place."""
+    global _initialized
+    _initialized = True
+
+
+batch = minibatch.batch

@@ -1,0 +1,25 @@
+"""Packaged datasets (reference: python/paddle/v2/dataset/ — mnist,
+cifar, imdb, imikolov, movielens, uci_housing, conll05, sentiment,
+wmt14, ...).
+
+This container has zero network egress, so each dataset first looks for
+a local cache (~/.cache/paddle_tpu/dataset/<name>) and otherwise serves
+a *deterministic synthetic corpus* with the exact record schema of the
+original (same tuple arity, dtypes, vocab sizes, image shapes) — enough
+for every demo/test to run unmodified; swap in the real files by
+dropping them into the cache dir."""
+
+from paddle_tpu.v2.dataset import (
+    cifar,
+    conll05,
+    imdb,
+    imikolov,
+    mnist,
+    movielens,
+    sentiment,
+    uci_housing,
+    wmt14,
+)
+
+__all__ = ["mnist", "cifar", "imdb", "imikolov", "movielens", "uci_housing",
+           "conll05", "sentiment", "wmt14"]

@@ -1,0 +1,34 @@
+"""CIFAR-10/100 (reference: python/paddle/v2/dataset/cifar.py).
+Records: (float32[3072] in [0,1], label)."""
+
+import numpy as np
+
+from paddle_tpu.v2.dataset import common
+
+
+def _synth(split, n, nclass):
+    def reader():
+        rng = common.synth_rng(f"cifar{nclass}", split)
+        protos = rng.rand(nclass, 3072).astype(np.float32)
+        for _ in range(n):
+            y = int(rng.randint(0, nclass))
+            x = np.clip(protos[y] + 0.1 * rng.randn(3072), 0, 1)
+            yield (x.astype(np.float32), y)
+
+    return reader
+
+
+def train10():
+    return _synth("train", 8192, 10)
+
+
+def test10():
+    return _synth("test", 1024, 10)
+
+
+def train100():
+    return _synth("train", 8192, 100)
+
+
+def test100():
+    return _synth("test", 1024, 100)

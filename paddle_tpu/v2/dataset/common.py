@@ -1,0 +1,19 @@
+"""Dataset helpers: cache dir + synthetic corpus RNG."""
+
+import os
+
+import numpy as np
+
+DATA_HOME = os.path.expanduser("~/.cache/paddle_tpu/dataset")
+
+
+def cache_path(*parts):
+    return os.path.join(DATA_HOME, *parts)
+
+
+def has_cache(*parts):
+    return os.path.exists(cache_path(*parts))
+
+
+def synth_rng(name: str, split: str):
+    return np.random.RandomState(abs(hash((name, split))) % (2 ** 31))

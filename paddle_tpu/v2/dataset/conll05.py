@@ -1,0 +1,48 @@
+"""CoNLL-05 semantic role labeling (reference: python/paddle/v2/dataset/
+conll05.py).  Records: (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2,
+verb_ids, mark_ids, label_ids) — all sequences of equal length."""
+
+import numpy as np
+
+from paddle_tpu.v2.dataset import common
+
+WORD_VOCAB = 44068
+PRED_VOCAB = 3162
+LABEL_COUNT = 67
+
+
+def get_dict():
+    word = {f"w{i}": i for i in range(WORD_VOCAB)}
+    verb = {f"v{i}": i for i in range(PRED_VOCAB)}
+    label = {f"l{i}": i for i in range(LABEL_COUNT)}
+    return word, verb, label
+
+
+def get_embedding():
+    rng = common.synth_rng("conll05", "emb")
+    return rng.randn(WORD_VOCAB, 32).astype(np.float32)
+
+
+def _synth(split, n):
+    def reader():
+        rng = common.synth_rng("conll05", split)
+        for _ in range(n):
+            L = int(rng.randint(5, 30))
+            words = rng.randint(0, WORD_VOCAB, L)
+            ctxs = [np.roll(words, s) for s in (2, 1, 0, -1, -2)]
+            verb = np.full(L, rng.randint(0, PRED_VOCAB))
+            mark = (rng.rand(L) < 0.2).astype(np.int64)
+            labels = (words * 7 + mark * 13) % LABEL_COUNT
+            yield tuple(
+                a.astype(np.int64).tolist()
+                for a in (words, *ctxs, verb, mark, labels))
+
+    return reader
+
+
+def test():
+    return _synth("test", 512)
+
+
+def train():
+    return _synth("train", 4096)

@@ -1,0 +1,35 @@
+"""IMDB sentiment (reference: python/paddle/v2/dataset/imdb.py).
+Records: (word-id sequence, label in {0,1})."""
+
+import numpy as np
+
+from paddle_tpu.v2.dataset import common
+
+_VOCAB = 5149  # reference vocab size for the era's imdb.pkl
+
+
+def word_dict():
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _synth(split, n, seq_range=(20, 100)):
+    def reader():
+        rng = common.synth_rng("imdb", split)
+        # two "topic" distributions make the task learnable
+        pos = rng.permutation(_VOCAB)
+        for _ in range(n):
+            y = int(rng.randint(0, 2))
+            ln = int(rng.randint(*seq_range))
+            base = pos[: _VOCAB // 2] if y else pos[_VOCAB // 2:]
+            seq = base[rng.randint(0, base.shape[0], ln)]
+            yield (seq.astype(np.int64).tolist(), y)
+
+    return reader
+
+
+def train(word_idx=None):
+    return _synth("train", 4096)
+
+
+def test(word_idx=None):
+    return _synth("test", 512)

@@ -1,0 +1,35 @@
+"""PTB n-gram LM data (reference: python/paddle/v2/dataset/imikolov.py).
+Records: n-gram tuples of word ids."""
+
+import numpy as np
+
+from paddle_tpu.v2.dataset import common
+
+_VOCAB = 2074
+
+
+def build_dict(min_word_freq=50):
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _synth(split, n, gram_n):
+    def reader():
+        rng = common.synth_rng("imikolov", split)
+        # markov-ish stream: next = (3 * cur + noise) % V
+        cur = int(rng.randint(0, _VOCAB))
+        for _ in range(n):
+            window = []
+            for _ in range(gram_n):
+                window.append(cur)
+                cur = int((3 * cur + rng.randint(0, 7)) % _VOCAB)
+            yield tuple(window)
+
+    return reader
+
+
+def train(word_idx=None, n=5):
+    return _synth("train", 8192, n)
+
+
+def test(word_idx=None, n=5):
+    return _synth("test", 1024, n)

@@ -1,0 +1,56 @@
+"""MovieLens-1M recommender (reference: python/paddle/v2/dataset/
+movielens.py).  Records: (user_id, gender, age, job, movie_id,
+category_ids, title_ids, rating)."""
+
+import numpy as np
+
+from paddle_tpu.v2.dataset import common
+
+MAX_USER = 6040
+MAX_MOVIE = 3952
+AGES = 7
+JOBS = 21
+CATEGORIES = 18
+TITLE_VOCAB = 5174
+
+
+def max_user_id():
+    return MAX_USER
+
+
+def max_movie_id():
+    return MAX_MOVIE
+
+
+def max_job_id():
+    return JOBS - 1
+
+
+def age_table():
+    return [1, 18, 25, 35, 45, 50, 56]
+
+
+def _synth(split, n):
+    def reader():
+        rng = common.synth_rng("movielens", split)
+        for _ in range(n):
+            uid = int(rng.randint(1, MAX_USER + 1))
+            mid = int(rng.randint(1, MAX_MOVIE + 1))
+            gender = int(rng.randint(0, 2))
+            age = int(rng.randint(0, AGES))
+            job = int(rng.randint(0, JOBS))
+            cats = rng.randint(0, CATEGORIES, rng.randint(1, 4)).tolist()
+            title = rng.randint(0, TITLE_VOCAB, rng.randint(2, 8)).tolist()
+            # rating correlated with (uid + mid) parity for learnability
+            rating = float(((uid * 31 + mid * 17) % 5) + 1)
+            yield (uid, gender, age, job, mid, cats, title, rating)
+
+    return reader
+
+
+def train():
+    return _synth("train", 8192)
+
+
+def test():
+    return _synth("test", 1024)

@@ -1,0 +1,16 @@
+"""Movie-review sentiment (reference: python/paddle/v2/dataset/
+sentiment.py — NLTK corpus).  Records: (word-id sequence, label)."""
+
+from paddle_tpu.v2.dataset import imdb
+
+
+def get_word_dict():
+    return imdb.word_dict()
+
+
+def train():
+    return imdb.train()
+
+
+def test():
+    return imdb.test()

@@ -1,0 +1,41 @@
+"""UCI housing (reference: python/paddle/v2/dataset/uci_housing.py).
+Records: (float32[13] features, float32[1] price)."""
+
+import numpy as np
+
+from paddle_tpu.v2.dataset import common
+
+feature_names = [
+    "CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS", "RAD", "TAX",
+    "PTRATIO", "B", "LSTAT",
+]
+
+_W = None
+
+
+def _weights():
+    global _W
+    if _W is None:
+        rng = common.synth_rng("uci_housing", "w")
+        _W = rng.randn(13).astype(np.float32)
+    return _W
+
+
+def _synth(split, n):
+    def reader():
+        rng = common.synth_rng("uci_housing", split)
+        w = _weights()
+        for _ in range(n):
+            x = rng.randn(13).astype(np.float32)
+            y = float(x @ w + 0.1 * rng.randn())
+            yield (x, np.asarray([y], np.float32))
+
+    return reader
+
+
+def train():
+    return _synth("train", 4096)
+
+
+def test():
+    return _synth("test", 512)

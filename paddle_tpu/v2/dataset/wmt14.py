@@ -1,0 +1,35 @@
+"""WMT14 fr-en NMT (reference: python/paddle/v2/dataset/wmt14.py).
+Records: (src_ids, trg_ids_with_bos, trg_ids_next) — the standard
+teacher-forcing triple."""
+
+import numpy as np
+
+from paddle_tpu.v2.dataset import common
+
+DICT_SIZE = 30000
+START = 0   # <s>
+END = 1     # <e>
+UNK = 2     # <unk>
+
+
+def _synth(split, n, max_len=20):
+    def reader():
+        rng = common.synth_rng("wmt14", split)
+        for _ in range(n):
+            L = int(rng.randint(4, max_len))
+            src = rng.randint(3, DICT_SIZE, L).astype(np.int64)
+            # deterministic "translation": reverse + offset (learnable)
+            trg = ((src[::-1] + 7) % (DICT_SIZE - 3) + 3).astype(np.int64)
+            trg_in = np.concatenate([[START], trg])
+            trg_next = np.concatenate([trg, [END]])
+            yield (src.tolist(), trg_in.tolist(), trg_next.tolist())
+
+    return reader
+
+
+def train(dict_size=DICT_SIZE):
+    return _synth("train", 4096)
+
+
+def test(dict_size=DICT_SIZE):
+    return _synth("test", 512)

@@ -1,0 +1,39 @@
+"""v2 inference (reference: python/paddle/v2/inference.py:111 infer)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from paddle_tpu import executor as executor_mod
+from paddle_tpu import framework
+from paddle_tpu.executor import Executor
+from paddle_tpu.framework import TPUPlace
+from paddle_tpu.v2.layer import LayerOutput, SeqVal
+from paddle_tpu.v2.topology import Topology
+
+
+class Inference:
+    def __init__(self, output_layer, parameters):
+        outputs = output_layer if isinstance(output_layer, (list, tuple)) \
+            else [output_layer]
+        self.topology = Topology(cost=None, output_layers=list(outputs),
+                                 is_test=True)
+        self.parameters = parameters
+        self._exe = Executor(TPUPlace())
+
+    def infer(self, input, feeding=None, field="value"):
+        from paddle_tpu.v2.trainer import V2DataFeeder
+
+        feeder = V2DataFeeder(self.topology.feed_types, feeding)
+        feed = feeder.feed(input)
+        with executor_mod.scope_guard(self.parameters.scope):
+            outs = self._exe.run(self.topology.main_program, feed=feed,
+                                 fetch_list=self.topology.output_vars)
+        outs = [np.asarray(o) for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+
+def infer(output_layer, parameters, input, feeding=None, field="value"):
+    return Inference(output_layer, parameters).infer(input, feeding, field)

@@ -1,0 +1,361 @@
+"""v2 layer DSL (reference: python/paddle/v2/layer.py re-exporting the
+trainer_config_helpers constructors, python/paddle/trainer_config_helpers/
+layers.py).
+
+Each constructor returns a lazy ``LayerOutput``; ``Topology`` walks the
+DAG once and emits ops into a fluid-style Program.  Sequence-typed
+values flow as ``(padded (B, T, ...), lengths (B,))`` pairs — the TPU
+replacement for the reference's ragged LoD arguments.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from paddle_tpu.v2.activation import BaseActivation, Linear
+from paddle_tpu.v2.data_type import InputType
+from paddle_tpu.v2.pooling import BasePoolingType, Max
+
+__all__ = [
+    "data", "fc", "embedding", "img_conv", "img_pool", "batch_norm",
+    "dropout", "concat", "pooling", "last_seq", "first_seq", "lstmemory",
+    "gru", "simple_rnn", "classification_cost", "cross_entropy_cost",
+    "mse_cost", "regression_cost", "max_id", "LayerOutput",
+]
+
+_counter = [0]
+
+
+def _uname(prefix):
+    _counter[0] += 1
+    return f"v2_{prefix}_{_counter[0]}"
+
+
+class SeqVal:
+    """A padded sequence value inside the build: (B, T, ...) + lengths."""
+
+    def __init__(self, var, lengths):
+        self.var = var
+        self.lengths = lengths
+
+
+class LayerOutput:
+    def __init__(self, name: str, parents: List["LayerOutput"],
+                 build_fn: Callable, size: Optional[int] = None,
+                 is_seq: bool = False, input_type: Optional[InputType] = None):
+        self.name = name
+        self.parents = parents
+        self.build_fn = build_fn
+        self.size = size
+        self.is_seq = is_seq
+        self.input_type = input_type
+        self._topology = None  # cached by parameters.create / trainer
+
+    def build(self, ctx: dict):
+        if id(self) in ctx:
+            return ctx[id(self)]
+        parent_vals = [p.build(ctx) for p in self.parents]
+        val = self.build_fn(ctx, *parent_vals)
+        ctx[id(self)] = val
+        return val
+
+
+def _act_name(act):
+    if act is None:
+        return None
+    if isinstance(act, BaseActivation):
+        return act.name
+    return act
+
+
+# ---------------------------------------------------------------------------
+# sources & transforms
+# ---------------------------------------------------------------------------
+
+
+def data(name: str, type: InputType, **kwargs) -> LayerOutput:
+    def build(ctx):
+        from paddle_tpu import layers as L
+
+        if type.is_seq:
+            if type.dtype == "int64":
+                var = L.data(name=name, shape=[-1], dtype="int64",
+                             append_batch_size=False)
+                var.shape = (-1, -1)  # (B, T)
+            else:
+                var = L.data(name=name, shape=[-1, type.dim], dtype=type.dtype,
+                             append_batch_size=False)
+                var.shape = (-1, -1, type.dim)
+            lens = L.data(name=name + "@len", shape=[-1], dtype="int32",
+                          append_batch_size=False)
+            ctx.setdefault("@feeds", []).append((name, type))
+            return SeqVal(var, lens)
+        shape = [type.dim] if type.dtype != "int64" else [1]
+        var = L.data(name=name, shape=shape, dtype=type.dtype)
+        ctx.setdefault("@feeds", []).append((name, type))
+        return var
+
+    return LayerOutput(name, [], build, size=type.dim, is_seq=type.is_seq,
+                       input_type=type)
+
+
+def fc(input, size: int, act=None, param_attr=None, bias_attr=None,
+       name=None, **kwargs) -> LayerOutput:
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+
+    def build(ctx, *vals):
+        from paddle_tpu import layers as L
+
+        outs = []
+        seq_len = None
+        fluid_ins = []
+        flatten = 1
+        for v in vals:
+            if isinstance(v, SeqVal):
+                fluid_ins.append(v.var)
+                seq_len = v.lengths
+                flatten = 2
+            else:
+                fluid_ins.append(v)
+        out = L.fc(input=fluid_ins if len(fluid_ins) > 1 else fluid_ins[0],
+                   size=size, num_flatten_dims=flatten,
+                   param_attr=param_attr, bias_attr=bias_attr,
+                   act=_act_name(act))
+        return SeqVal(out, seq_len) if seq_len is not None else out
+
+    any_seq = any(getattr(i, "is_seq", False) for i in inputs)
+    return LayerOutput(name or _uname("fc"), list(inputs), build, size=size,
+                       is_seq=any_seq)
+
+
+def embedding(input, size: int, param_attr=None, name=None, **kwargs):
+    # vocab size comes from the parent data layer's declared range
+    def build(ctx, ids):
+        from paddle_tpu import layers as L
+        from paddle_tpu.layer_helper import LayerHelper
+
+        seq = isinstance(ids, SeqVal)
+        idv = ids.var if seq else ids
+        vocab = input.input_type.dim if input.input_type else input.size
+        if seq:
+            # lookup_table wants a trailing index dim: (B, T) -> (B, T, 1)
+            helper = LayerHelper("v2_emb_reshape")
+            r = helper.create_tmp_variable("int64", (-1, -1, 1))
+            helper.append_op(type="reshape", inputs={"X": [idv]},
+                             outputs={"Out": [r]}, attrs={"shape": [0, -1, 1]})
+            idv = r
+        emb = L.embedding(input=idv, size=[vocab, size], param_attr=param_attr)
+        return SeqVal(emb, ids.lengths) if seq else emb
+
+    return LayerOutput(name or _uname("embedding"), [input], build, size=size,
+                       is_seq=input.is_seq)
+
+
+def img_conv(input, filter_size, num_filters, num_channels=None, stride=1,
+             padding=0, act=None, param_attr=None, bias_attr=None,
+             name=None, **kwargs):
+    def build(ctx, x):
+        from paddle_tpu import layers as L
+
+        return L.conv2d(input=x, num_filters=num_filters,
+                        filter_size=filter_size, stride=stride,
+                        padding=padding, act=_act_name(act),
+                        param_attr=param_attr, bias_attr=bias_attr)
+
+    return LayerOutput(name or _uname("conv"), [input], build,
+                       size=num_filters)
+
+
+def img_pool(input, pool_size, pool_type=None, stride=1, padding=0,
+             name=None, **kwargs):
+    ptype = pool_type.name if isinstance(pool_type, BasePoolingType) else (pool_type or "max")
+
+    def build(ctx, x):
+        from paddle_tpu import layers as L
+
+        return L.pool2d(input=x, pool_size=pool_size, pool_type=ptype,
+                        pool_stride=stride, pool_padding=padding)
+
+    return LayerOutput(name or _uname("pool"), [input], build)
+
+
+def batch_norm(input, act=None, name=None, **kwargs):
+    def build(ctx, x):
+        from paddle_tpu import layers as L
+
+        return L.batch_norm(input=x, act=_act_name(act),
+                            is_test=bool(ctx.get("@is_test", False)))
+
+    return LayerOutput(name or _uname("bn"), [input], build, size=input.size)
+
+
+def dropout(input, dropout_rate: float, name=None, **kwargs):
+    def build(ctx, x):
+        from paddle_tpu import layers as L
+
+        v = x.var if isinstance(x, SeqVal) else x
+        out = L.dropout(x=v, dropout_prob=dropout_rate,
+                        is_test=bool(ctx.get("@is_test", False)))
+        return SeqVal(out, x.lengths) if isinstance(x, SeqVal) else out
+
+    return LayerOutput(name or _uname("dropout"), [input], build,
+                       size=input.size, is_seq=input.is_seq)
+
+
+def concat(input: list, name=None, **kwargs):
+    def build(ctx, *vals):
+        from paddle_tpu import layers as L
+
+        return L.concat([v.var if isinstance(v, SeqVal) else v for v in vals],
+                        axis=-1 if False else 1)
+
+    return LayerOutput(name or _uname("concat"), list(input), build)
+
+
+# ---------------------------------------------------------------------------
+# sequence layers (padded + mask)
+# ---------------------------------------------------------------------------
+
+
+def _masked(ctx, seq: SeqVal, mode: str):
+    """Masked pooling over time: (B, T, D), lengths (B,) -> (B, D)."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    helper = LayerHelper("v2_seqpool")
+    shape = None
+    if seq.var.shape is not None:
+        shape = (seq.var.shape[0],) + tuple(seq.var.shape[2:])
+    out = helper.create_tmp_variable("float32", shape)
+    helper.append_op(
+        type="padded_sequence_pool",
+        inputs={"X": [seq.var], "Length": [seq.lengths]},
+        outputs={"Out": [out]},
+        attrs={"pooltype": mode.upper()},
+    )
+    return out
+
+
+def pooling(input, pooling_type: Optional[BasePoolingType] = None, name=None,
+            **kwargs):
+    ptype = (pooling_type or Max()).name
+
+    def build(ctx, seq):
+        assert isinstance(seq, SeqVal), "pooling expects a sequence input"
+        return _masked(ctx, seq, ptype)
+
+    return LayerOutput(name or _uname("seqpool"), [input], build,
+                       size=input.size)
+
+
+def last_seq(input, name=None, **kwargs):
+    def build(ctx, seq):
+        return _masked(ctx, seq, "last")
+
+    return LayerOutput(name or _uname("last_seq"), [input], build,
+                       size=input.size)
+
+
+def first_seq(input, name=None, **kwargs):
+    def build(ctx, seq):
+        return _masked(ctx, seq, "first")
+
+    return LayerOutput(name or _uname("first_seq"), [input], build,
+                       size=input.size)
+
+
+def lstmemory(input, size: Optional[int] = None, reverse: bool = False,
+              act=None, name=None, **kwargs):
+    """LSTM over a pre-projected (B, T, 4H) sequence (reference:
+    trainer_config_helpers lstmemory — input must be size*4 projected)."""
+
+    def build(ctx, seq):
+        from paddle_tpu import layers as L
+
+        assert isinstance(seq, SeqVal)
+        h = size if size is not None else (input.size // 4)
+        hidden, _cell = L.lstm(input=seq.var, size=h, is_reverse=reverse)
+        return SeqVal(hidden, seq.lengths)
+
+    return LayerOutput(name or _uname("lstm"), [input], build,
+                       size=size if size is not None else (input.size // 4 if input.size else None),
+                       is_seq=True)
+
+
+def gru(input, size: int, reverse: bool = False, name=None, **kwargs):
+    def build(ctx, seq):
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper("v2_gru")
+        w = helper.create_parameter(None, shape=[size, 3 * size], dtype="float32")
+        b = helper.create_parameter(None, shape=[1, 3 * size], dtype="float32",
+                                    is_bias=True)
+        hidden = helper.create_tmp_variable("float32", None)
+        helper.append_op(
+            type="gru",
+            inputs={"Input": [seq.var], "Weight": [w], "Bias": [b]},
+            outputs={"Hidden": [hidden]},
+            attrs={"is_reverse": reverse})
+        return SeqVal(hidden, seq.lengths)
+
+    return LayerOutput(name or _uname("gru"), [input], build, size=size,
+                       is_seq=True)
+
+
+def simple_rnn(input, size: int, act=None, reverse: bool = False, name=None,
+               **kwargs):
+    def build(ctx, seq):
+        from paddle_tpu import layers as L
+
+        rnn = L.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(seq.var)
+            h = rnn.memory(batch_ref=x_t, shape=[-1, size], init_value=0.0)
+            nh = L.fc(input=[x_t, h], size=size,
+                      act=_act_name(act) or "tanh", bias_attr=True)
+            rnn.update_memory(h, nh)
+            rnn.step_output(nh)
+        (out,) = rnn()
+        return SeqVal(out, seq.lengths)
+
+    return LayerOutput(name or _uname("rnn"), [input], build, size=size,
+                       is_seq=True)
+
+
+# ---------------------------------------------------------------------------
+# costs & outputs
+# ---------------------------------------------------------------------------
+
+
+def cross_entropy_cost(input, label, name=None, **kwargs):
+    def build(ctx, pred, lab):
+        from paddle_tpu import layers as L
+
+        ce = L.cross_entropy(input=pred, label=lab)
+        return L.mean(ce)
+
+    return LayerOutput(name or _uname("cost"), [input, label], build, size=1)
+
+
+classification_cost = cross_entropy_cost
+
+
+def mse_cost(input, label, name=None, **kwargs):
+    def build(ctx, pred, lab):
+        from paddle_tpu import layers as L
+
+        return L.mean(L.square_error_cost(input=pred, label=lab))
+
+    return LayerOutput(name or _uname("mse"), [input, label], build, size=1)
+
+
+regression_cost = mse_cost
+
+
+def max_id(input, name=None, **kwargs):
+    def build(ctx, x):
+        from paddle_tpu import layers as L
+
+        _vals, idx = L.topk(x, k=1)
+        return idx
+
+    return LayerOutput(name or _uname("max_id"), [input], build, size=1)

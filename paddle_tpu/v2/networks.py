@@ -1,0 +1,38 @@
+"""v2 network compositions (reference: python/paddle/
+trainer_config_helpers/networks.py — simple_img_conv_pool,
+simple_lstm, bidirectional_lstm, ...)."""
+
+from __future__ import annotations
+
+from paddle_tpu.v2 import layer as L
+from paddle_tpu.v2.activation import Relu, Tanh
+from paddle_tpu.v2.pooling import Max
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         pool_stride, act=None, num_channel=None, **kwargs):
+    conv = L.img_conv(input=input, filter_size=filter_size,
+                      num_filters=num_filters, num_channels=num_channel,
+                      act=act)
+    return L.img_pool(input=conv, pool_size=pool_size, stride=pool_stride,
+                      pool_type=Max())
+
+
+def simple_lstm(input, size, reverse=False, **kwargs):
+    proj = L.fc(input=input, size=size * 4, bias_attr=False)
+    return L.lstmemory(input=proj, size=size, reverse=reverse)
+
+
+def bidirectional_lstm(input, size, return_seq=False, **kwargs):
+    fwd = simple_lstm(input, size)
+    bwd = simple_lstm(input, size, reverse=True)
+    if return_seq:
+        return L.concat([fwd, bwd])
+    return L.concat([L.last_seq(fwd), L.first_seq(bwd)])
+
+
+def stacked_lstm(input, size, depth=2, **kwargs):
+    x = input
+    for _ in range(depth):
+        x = simple_lstm(x, size)
+    return x

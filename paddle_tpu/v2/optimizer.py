@@ -1,0 +1,123 @@
+"""v2 optimizers (reference: python/paddle/v2/optimizer.py — wrappers
+that build updaters; here they wrap the core op-appending optimizers)."""
+
+from __future__ import annotations
+
+from paddle_tpu import optimizer as core_opt
+from paddle_tpu import regularizer as core_reg
+
+
+def _reg(regularization):
+    return regularization
+
+
+class Optimizer:
+    core_cls = None
+
+    def __init__(self, learning_rate=0.01, regularization=None,
+                 gradient_clipping_threshold=None, learning_rate_decay_a=None,
+                 learning_rate_decay_b=None, model_average=None, **kwargs):
+        clip = None
+        if gradient_clipping_threshold:
+            from paddle_tpu.clip import GradientClipByGlobalNorm
+
+            clip = GradientClipByGlobalNorm(gradient_clipping_threshold)
+        self._core = self._make_core(learning_rate, grad_clip=clip, **kwargs)
+        self.regularization = regularization
+
+    def _make_core(self, lr, **kwargs):
+        raise NotImplementedError
+
+    def minimize(self, loss, startup_program=None):
+        return self._core.minimize(loss, startup_program=startup_program)
+
+
+class Momentum(Optimizer):
+    def __init__(self, momentum=0.9, sparse=False, **kwargs):
+        self._momentum = momentum
+        super().__init__(**kwargs)
+
+    def _make_core(self, lr, **kwargs):
+        return core_opt.MomentumOptimizer(lr, self._momentum, **kwargs)
+
+
+class Adam(Optimizer):
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8, **kwargs):
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+        super().__init__(**kwargs)
+
+    def _make_core(self, lr, **kwargs):
+        return core_opt.AdamOptimizer(lr, beta1=self._b1, beta2=self._b2,
+                                      epsilon=self._eps, **kwargs)
+
+
+class Adamax(Optimizer):
+    def __init__(self, beta1=0.9, beta2=0.999, **kwargs):
+        self._b1, self._b2 = beta1, beta2
+        super().__init__(**kwargs)
+
+    def _make_core(self, lr, **kwargs):
+        return core_opt.AdamaxOptimizer(lr, beta1=self._b1, beta2=self._b2,
+                                        **kwargs)
+
+
+class AdaGrad(Optimizer):
+    def _make_core(self, lr, **kwargs):
+        return core_opt.AdagradOptimizer(lr, **kwargs)
+
+
+class DecayedAdaGrad(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, **kwargs):
+        self._rho, self._eps = rho, epsilon
+        super().__init__(**kwargs)
+
+    def _make_core(self, lr, **kwargs):
+        return core_opt.DecayedAdagradOptimizer(lr, decay=self._rho,
+                                                epsilon=self._eps, **kwargs)
+
+
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, **kwargs):
+        self._rho, self._eps = rho, epsilon
+        super().__init__(**kwargs)
+
+    def _make_core(self, lr, **kwargs):
+        # core adadelta has no lr input; emulate via plain optimizer
+        class _AdaDelta(core_opt.Optimizer):
+            def __init__(s, lr_, rho, eps, **kw):
+                super().__init__(lr_, **kw)
+                s._rho, s._eps = rho, eps
+
+            def _create_accumulators(s, block, params):
+                for p in params:
+                    s._add_accumulator("avg_sq_grad", p)
+                    s._add_accumulator("avg_sq_update", p)
+
+            def _append_optimize_op(s, block, pg):
+                p, g = pg
+                return block.append_op(
+                    type="adadelta",
+                    inputs={"Param": [p], "Grad": [g],
+                            "AvgSquaredGrad": [s._get_accumulator("avg_sq_grad", p)],
+                            "AvgSquaredUpdate": [s._get_accumulator("avg_sq_update", p)]},
+                    outputs={"ParamOut": [p],
+                             "AvgSquaredGradOut": [s._get_accumulator("avg_sq_grad", p)],
+                             "AvgSquaredUpdateOut": [s._get_accumulator("avg_sq_update", p)]},
+                    attrs={"rho": s._rho, "epsilon": s._eps})
+
+        return _AdaDelta(lr, self._rho, self._eps, **kwargs)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, **kwargs):
+        self._rho, self._eps = rho, epsilon
+        super().__init__(**kwargs)
+
+    def _make_core(self, lr, **kwargs):
+        return core_opt.RMSPropOptimizer(lr, rho=self._rho, epsilon=self._eps,
+                                         **kwargs)
+
+
+# regularization helpers matching the reference surface
+L2Regularization = core_reg.L2DecayRegularizer
+L1Regularization = core_reg.L1DecayRegularizer

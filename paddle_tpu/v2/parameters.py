@@ -1,0 +1,99 @@
+"""Parameters: numpy views over the trained weights (reference:
+python/paddle/v2/parameters.py:44 — there backed by the SWIG
+GradientMachine; here by the executor scope)."""
+
+from __future__ import annotations
+
+import tarfile
+import io as _io
+from typing import Optional
+
+import numpy as np
+
+from paddle_tpu import executor as executor_mod
+from paddle_tpu.executor import Executor, global_scope
+from paddle_tpu.framework import TPUPlace
+from paddle_tpu.v2.topology import Topology
+
+
+def create(cost_or_topology) -> "Parameters":
+    from paddle_tpu.v2.layer import LayerOutput
+
+    if isinstance(cost_or_topology, Topology):
+        topo = cost_or_topology
+    else:
+        lo: LayerOutput = cost_or_topology
+        if lo._topology is None:
+            lo._topology = Topology(lo)
+        topo = lo._topology
+    return Parameters(topo)
+
+
+class Parameters:
+    def __init__(self, topology: Topology):
+        self.topology = topology
+        self.scope = executor_mod.Scope()
+        exe = Executor(TPUPlace())
+        with executor_mod.scope_guard(self.scope):
+            exe.run(topology.startup_program)
+        self._names = [p.name for p in topology.main_program.all_parameters()]
+
+    def keys(self):
+        return list(self._names)
+
+    names = keys
+
+    def has_key(self, key):
+        return key in self._names
+
+    def __iter__(self):
+        return iter(self._names)
+
+    def get(self, name) -> np.ndarray:
+        v = self.scope.get(name)
+        if v is None:
+            raise KeyError(name)
+        return np.asarray(v)
+
+    __getitem__ = get
+
+    def set(self, name, value):
+        self.scope.set(name, np.asarray(value))
+
+    __setitem__ = set
+
+    def get_shape(self, name):
+        return tuple(self.get(name).shape)
+
+    # -- serialization (reference: parameters.to_tar / from_tar) -----------
+
+    def to_tar(self, f):
+        with tarfile.open(fileobj=f, mode="w") as tar:
+            for name in self._names:
+                arr = self.get(name)
+                buf = _io.BytesIO()
+                np.save(buf, arr, allow_pickle=False)
+                data = buf.getvalue()
+                info = tarfile.TarInfo(name=name + ".npy")
+                info.size = len(data)
+                tar.addfile(info, _io.BytesIO(data))
+
+    @classmethod
+    def from_tar(cls, f, topology: Optional[Topology] = None) -> "Parameters":
+        assert topology is not None, (
+            "from_tar needs the Topology (pass parameters=...create(cost) "
+            "first, then from_tar(f, params.topology))")
+        p = cls(topology)
+        p.load_tar(f)
+        return p
+
+    def load_tar(self, f):
+        with tarfile.open(fileobj=f, mode="r") as tar:
+            for m in tar.getmembers():
+                name = m.name[:-4] if m.name.endswith(".npy") else m.name
+                arr = np.load(_io.BytesIO(tar.extractfile(m).read()),
+                              allow_pickle=False)
+                self.scope.set(name, arr)
+
+    def init_from_tar(self, f):
+        self.load_tar(f)

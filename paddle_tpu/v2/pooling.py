@@ -1,0 +1,21 @@
+"""Sequence pooling types (reference: python/paddle/v2/pooling.py)."""
+
+
+class BasePoolingType:
+    name = None
+
+
+class Max(BasePoolingType):
+    name = "max"
+
+
+class Avg(BasePoolingType):
+    name = "avg"
+
+
+class Sum(BasePoolingType):
+    name = "sum"
+
+
+class SquareRootN(BasePoolingType):
+    name = "sqrt"

@@ -1,0 +1,10 @@
+from paddle_tpu.v2.reader.decorator import (
+    buffered,
+    cache,
+    chain,
+    compose,
+    firstn,
+    map_readers,
+    shuffle,
+)
+from paddle_tpu.v2.reader import creator

@@ -1,0 +1,52 @@
+"""Reader creators (reference: python/paddle/v2/reader/creator.py —
+np_array, text_file, cloud_reader; cloud_reader's master-client task
+stream is served by the native coordination service instead of the Go
+master)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def np_array(x):
+    def reader():
+        for e in np.asarray(x):
+            yield e
+
+    return reader
+
+
+def text_file(path):
+    def reader():
+        with open(path) as f:
+            for line in f:
+                yield line.rstrip("\n")
+
+    return reader
+
+
+def cloud_reader(paths, etcd_endpoints=None, timeout_sec=5, buf_size=64):
+    """Task-stream reader backed by the coordination service
+    (reference: v2/reader/creator.py:91 + go/master client).  Falls back
+    to reading the files directly when no master address is configured."""
+    import os
+
+    master_addr = os.environ.get("PADDLE_MASTER_ADDR")
+    if master_addr:
+        from paddle_tpu.distributed.master_client import MasterClient
+
+        client = MasterClient(master_addr)
+
+        def reader():
+            for rec in client.records(paths):
+                yield rec
+
+        return reader
+
+    def reader():
+        for p in paths:
+            with open(p, "rb") as f:
+                for line in f:
+                    yield line.rstrip(b"\n")
+
+    return reader

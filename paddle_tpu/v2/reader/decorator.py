@@ -1,0 +1,116 @@
+"""Reader combinators (reference: python/paddle/v2/reader/decorator.py)."""
+
+from __future__ import annotations
+
+import itertools
+import random
+from queue import Queue
+from threading import Thread
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return data_reader
+
+
+def chain(*readers):
+    def reader():
+        for r in readers:
+            for e in r():
+                yield e
+
+    return reader
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        if isinstance(x, tuple):
+            return x
+        return (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if check_alignment:
+            for outputs in zip(*rs):
+                yield sum(list(map(make_tuple, outputs)), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    break
+                yield sum(list(map(make_tuple, outputs)), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Prefetch into a bounded queue on a worker thread."""
+
+    class _End:
+        pass
+
+    def data_reader():
+        q: Queue = Queue(maxsize=size)
+
+        def fill():
+            for d in reader():
+                q.put(d)
+            q.put(_End)
+
+        t = Thread(target=fill)
+        t.daemon = True
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            yield e
+
+    return data_reader
+
+
+def firstn(reader, n):
+    def data_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+
+    return data_reader
+
+
+def cache(reader):
+    all_data = []
+    filled = []
+
+    def data_reader():
+        if not filled:
+            all_data.extend(reader())
+            filled.append(True)
+        for d in all_data:
+            yield d
+
+    return data_reader

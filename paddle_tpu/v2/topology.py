@@ -1,0 +1,47 @@
+"""Topology: walk the v2 layer DAG and emit a fluid Program
+(reference: python/paddle/v2/topology.py:27 — there it serializes to a
+ModelConfig proto; here it traces straight into the Program IR)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from paddle_tpu import framework
+from paddle_tpu.v2.layer import LayerOutput, SeqVal
+
+
+class Topology:
+    def __init__(self, cost: Optional[LayerOutput] = None,
+                 extra_layers: Optional[List[LayerOutput]] = None,
+                 output_layers: Optional[List[LayerOutput]] = None,
+                 is_test: bool = False):
+        outputs = list(output_layers or [])
+        if cost is not None:
+            outputs = [cost] + outputs
+        outputs += list(extra_layers or [])
+        self.cost = cost
+        self.main_program = framework.Program()
+        self.startup_program = framework.Program()
+        self.ctx: dict = {"@is_test": is_test}
+        # deterministic names: the same layer DAG must produce identical
+        # parameter names on every build (training topology vs inference
+        # topology share one Parameters scope)
+        saved_gen = framework._name_gen
+        framework._name_gen = framework._UniqueNameGenerator()
+        try:
+            with framework.program_guard(self.main_program, self.startup_program):
+                self.output_vars = []
+                for lo in outputs:
+                    v = lo.build(self.ctx)
+                    self.output_vars.append(v.var if isinstance(v, SeqVal) else v)
+        finally:
+            framework._name_gen = saved_gen
+        self.cost_var = self.output_vars[0] if cost is not None else None
+        # (name, InputType) in declaration order
+        self.feed_types = list(self.ctx.get("@feeds", []))
+
+    def data_layers(self):
+        return {name: t for name, t in self.feed_types}
+
+    def feed_names(self):
+        return [name for name, _ in self.feed_types]

@@ -1,0 +1,124 @@
+"""v2 SGD trainer (reference: python/paddle/v2/trainer.py:24 SGD, train
+loop :158-202).  One compiled program per (topology, batch signature);
+events fire per batch/pass as in the reference."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu import executor as executor_mod
+from paddle_tpu import framework
+from paddle_tpu.executor import Executor
+from paddle_tpu.framework import TPUPlace
+from paddle_tpu.v2 import event as v2_event
+from paddle_tpu.v2.data_type import InputType
+from paddle_tpu.v2.layer import LayerOutput
+from paddle_tpu.v2.parameters import Parameters
+from paddle_tpu.v2.topology import Topology
+
+
+def _round_up(n, m):
+    return ((n + m - 1) // m) * m
+
+
+class V2DataFeeder:
+    """Converts reader rows to the padded dense feed layout."""
+
+    def __init__(self, feed_types: List, feeding: Optional[Dict[str, int]] = None,
+                 time_bucket: int = 16):
+        self.feed_types = feed_types  # [(name, InputType)]
+        self.feeding = feeding
+        self.time_bucket = time_bucket
+
+    def feed(self, minibatch: Sequence[Sequence]) -> Dict[str, np.ndarray]:
+        out = {}
+        for col, (name, t) in enumerate(self.feed_types):
+            idx = self.feeding[name] if self.feeding else col
+            column = [row[idx] for row in minibatch]
+            if t.is_seq:
+                lens = np.asarray([len(c) for c in column], np.int32)
+                T = _round_up(max(int(lens.max()), 1), self.time_bucket)
+                if t.dtype == "int64":
+                    arr = np.zeros((len(column), T), np.int64)
+                    for i, c in enumerate(column):
+                        arr[i, : len(c)] = np.asarray(c, np.int64)
+                else:
+                    arr = np.zeros((len(column), T, t.dim), np.float32)
+                    for i, c in enumerate(column):
+                        arr[i, : len(c)] = np.asarray(c, np.float32)
+                out[name] = arr
+                out[name + "@len"] = lens
+            elif getattr(t, "sparse", False):
+                dense = np.zeros((len(column), t.dim), np.float32)
+                for i, c in enumerate(column):
+                    if len(c) and isinstance(c[0], (tuple, list)):
+                        for j, v in c:
+                            dense[i, j] = v
+                    else:
+                        dense[i, np.asarray(c, np.int64)] = 1.0
+                out[name] = dense
+            elif t.dtype == "int64":
+                out[name] = np.asarray(column, np.int64).reshape(len(column), -1)
+            else:
+                arr = np.asarray(column, np.float32)
+                if arr.ndim == 1:
+                    arr = arr.reshape(-1, 1)
+                out[name] = arr
+        return out
+
+
+class SGD:
+    """paddle.v2.trainer.SGD."""
+
+    def __init__(self, cost: LayerOutput, parameters: Parameters,
+                 update_equation, extra_layers=None, is_local: bool = True,
+                 **kwargs):
+        if cost._topology is not None and parameters.topology is cost._topology:
+            self.topology = parameters.topology
+        else:
+            self.topology = parameters.topology
+        self.parameters = parameters
+        self._extra = list(extra_layers or [])
+        with framework.program_guard(self.topology.main_program,
+                                     self.topology.startup_program):
+            update_equation.minimize(self.topology.cost_var,
+                                     startup_program=self.topology.startup_program)
+        # startup may have grown (lr/accumulators): re-init the new vars
+        exe = Executor(TPUPlace())
+        with executor_mod.scope_guard(self.parameters.scope):
+            exe.run(self.topology.startup_program)
+        self._exe = exe
+        self._test_program = None
+
+    def train(self, reader: Callable, num_passes: int = 1,
+              event_handler: Optional[Callable] = None,
+              feeding: Optional[Dict[str, int]] = None):
+        event_handler = event_handler or (lambda e: None)
+        feeder = V2DataFeeder(self.topology.feed_types, feeding)
+        fetch = [self.topology.cost_var]
+        for pass_id in range(num_passes):
+            event_handler(v2_event.BeginPass(pass_id))
+            for batch_id, data in enumerate(reader()):
+                event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                feed = feeder.feed(data)
+                with executor_mod.scope_guard(self.parameters.scope):
+                    (cost,) = self._exe.run(self.topology.main_program,
+                                            feed=feed, fetch_list=fetch)
+                event_handler(v2_event.EndIteration(
+                    pass_id, batch_id, float(np.asarray(cost).reshape(-1)[0])))
+            event_handler(v2_event.EndPass(pass_id))
+
+    def test(self, reader: Callable, feeding: Optional[Dict[str, int]] = None):
+        if self._test_program is None:
+            self._test_program = self.topology.main_program.clone(for_test=True)
+        feeder = V2DataFeeder(self.topology.feed_types, feeding)
+        costs = []
+        for data in reader():
+            feed = feeder.feed(data)
+            with executor_mod.scope_guard(self.parameters.scope):
+                (cost,) = self._exe.run(self._test_program, feed=feed,
+                                        fetch_list=[self.topology.cost_var])
+            costs.append(float(np.asarray(cost).reshape(-1)[0]))
+        return v2_event.TestResult(cost=float(np.mean(costs)) if costs else None)

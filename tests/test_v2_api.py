@@ -1,0 +1,126 @@
+"""v2 API facade end-to-end tests (reference model: the v1_api_demo /
+v2 quick-start flows: uci_housing fit-a-line, mnist, imdb sentiment)."""
+
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu.v2 as paddle
+
+
+def test_fit_a_line_v2():
+    paddle.init(use_gpu=False, trainer_count=1)
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(13))
+    y = paddle.layer.data(name="y", type=paddle.data_type.dense_vector(1))
+    y_predict = paddle.layer.fc(input=x, size=1)
+    cost = paddle.layer.mse_cost(input=y_predict, label=y)
+
+    parameters = paddle.parameters.create(cost)
+    optimizer = paddle.optimizer.Momentum(momentum=0.9, learning_rate=1e-3)
+    trainer = paddle.trainer.SGD(cost=cost, parameters=parameters,
+                                 update_equation=optimizer)
+
+    costs = []
+
+    def event_handler(event):
+        if isinstance(event, paddle.event.EndIteration):
+            costs.append(event.cost)
+
+    reader = paddle.batch(
+        paddle.reader.shuffle(paddle.dataset.uci_housing.train(),
+                              buf_size=500),
+        batch_size=32)
+    trainer.train(reader=reader, num_passes=2, event_handler=event_handler)
+    assert costs[-1] < 0.5 * costs[0], (costs[0], costs[-1])
+
+    result = trainer.test(reader=paddle.batch(
+        paddle.dataset.uci_housing.test(), batch_size=32))
+    assert result.cost is not None and np.isfinite(result.cost)
+
+
+def test_mnist_v2_with_infer():
+    paddle.init()
+    images = paddle.layer.data(name="pixel",
+                               type=paddle.data_type.dense_vector(784))
+    label = paddle.layer.data(name="label",
+                              type=paddle.data_type.integer_value(10))
+    hidden = paddle.layer.fc(input=images, size=64,
+                             act=paddle.activation.Relu())
+    predict = paddle.layer.fc(input=hidden, size=10,
+                              act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=predict, label=label)
+
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=1e-3))
+    reader = paddle.batch(paddle.dataset.mnist.train(), batch_size=64)
+    seen = []
+    trainer.train(reader=paddle.reader.firstn(reader, 40), num_passes=1,
+                  event_handler=lambda e: seen.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None)
+    assert seen[-1] < 0.7 * seen[0], (seen[0], seen[-1])
+
+    # inference on the prediction layer using the trained parameters
+    test_rows = [r for r, _ in zip(paddle.dataset.mnist.test()(), range(8))]
+    probs = paddle.infer(output_layer=predict, parameters=parameters,
+                         input=[(r[0],) for r in test_rows])
+    assert probs.shape == (8, 10)
+    np.testing.assert_allclose(probs.sum(axis=1), np.ones(8), atol=1e-3)
+
+
+def test_imdb_lstm_sequence_path():
+    """Sequence data type -> padded feed -> lstm -> masked pooling."""
+    paddle.init()
+    words = paddle.layer.data(
+        name="words",
+        type=paddle.data_type.integer_value_sequence(5149))
+    label = paddle.layer.data(name="label",
+                              type=paddle.data_type.integer_value(2))
+    emb = paddle.layer.embedding(input=words, size=32)
+    lstm = paddle.networks.simple_lstm(emb, 32)
+    pooled = paddle.layer.pooling(input=lstm,
+                                  pooling_type=paddle.pooling.Max())
+    predict = paddle.layer.fc(input=pooled, size=2,
+                              act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=predict, label=label)
+
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=2e-3))
+    reader = paddle.batch(paddle.dataset.imdb.train(), batch_size=32)
+    seen = []
+    trainer.train(reader=paddle.reader.firstn(reader, 30), num_passes=1,
+                  event_handler=lambda e: seen.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None)
+    assert seen[-1] < 0.9 * seen[0], (seen[0], seen[-1])
+
+
+def test_parameters_tar_roundtrip():
+    paddle.init()
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    y = paddle.layer.data(name="y", type=paddle.data_type.dense_vector(1))
+    pred = paddle.layer.fc(input=x, size=1)
+    cost = paddle.layer.mse_cost(input=pred, label=y)
+    params = paddle.parameters.create(cost)
+    name = params.keys()[0]
+    w = params.get(name)
+    buf = io.BytesIO()
+    params.to_tar(buf)
+    params.set(name, np.zeros_like(w))
+    buf.seek(0)
+    params.load_tar(buf)
+    np.testing.assert_allclose(params.get(name), w)
+
+
+def test_reader_decorators():
+    r = paddle.reader.firstn(
+        paddle.reader.shuffle(paddle.dataset.uci_housing.train(), 100), 10)
+    rows = list(r())
+    assert len(rows) == 10
+    c = paddle.reader.compose(paddle.dataset.uci_housing.train(),
+                              paddle.dataset.uci_housing.train())
+    row = next(c())
+    assert len(row) == 4  # two (x, y) pairs concatenated
