@@ -1,0 +1,123 @@
+// Multithreaded prefetching data loader.
+//
+// The reference's data path is PyDataProvider2: a C++ pool thread
+// driving user Python generators with double buffering
+// (gserver/dataproviders/PyDataProvider2.cpp:195, DataProvider.h
+// DoubleBuffer).  TPU training wants the host loop off the critical
+// path entirely: N reader threads parse RecordIO shards into a bounded
+// ring queue; the Python side drains whole batches without holding the
+// GIL during file IO.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+struct RecordReader;
+RecordReader* recordio_reader_open(const char* path);
+long recordio_read(RecordReader* r, uint8_t* out, uint32_t cap);
+void recordio_reader_close(RecordReader* r);
+}
+
+namespace {
+
+struct Loader {
+  std::vector<std::string> paths;
+  size_t capacity;
+  uint32_t max_record;
+
+  std::deque<std::vector<uint8_t>> queue;
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  std::vector<std::thread> workers;
+  std::atomic<int> live_workers{0};
+  std::atomic<bool> stop{false};
+
+  void worker(size_t start_idx, size_t stride) {
+    std::vector<uint8_t> buf(max_record);
+    for (size_t i = start_idx; i < paths.size() && !stop; i += stride) {
+      RecordReader* r = recordio_reader_open(paths[i].c_str());
+      if (!r) continue;
+      while (!stop) {
+        long n = recordio_read(r, buf.data(), max_record);
+        if (n == -1) break;       // EOF
+        if (n < 0) continue;      // skip corrupt record
+        std::vector<uint8_t> rec(buf.begin(), buf.begin() + n);
+        std::unique_lock<std::mutex> lk(mu);
+        cv_push.wait(lk, [&] { return queue.size() < capacity || stop; });
+        if (stop) break;
+        queue.push_back(std::move(rec));
+        cv_pop.notify_one();
+      }
+      recordio_reader_close(r);
+    }
+    if (--live_workers == 0) {
+      std::lock_guard<std::mutex> lk(mu);
+      cv_pop.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+Loader* dl_open(const char* paths_csv, int num_threads, int capacity,
+                int max_record) {
+  auto* l = new Loader();
+  l->capacity = capacity > 0 ? capacity : 256;
+  l->max_record = max_record > 0 ? (uint32_t)max_record : (16u << 20);
+  const char* p = paths_csv;
+  while (*p) {
+    const char* c = strchr(p, ',');
+    if (!c) {
+      l->paths.emplace_back(p);
+      break;
+    }
+    l->paths.emplace_back(p, c - p);
+    p = c + 1;
+  }
+  int n = num_threads > 0 ? num_threads : 1;
+  if ((size_t)n > l->paths.size() && !l->paths.empty())
+    n = (int)l->paths.size();
+  l->live_workers = n;
+  for (int i = 0; i < n; i++)
+    l->workers.emplace_back([l, i, n] { l->worker(i, n); });
+  return l;
+}
+
+// Returns record length copied into out, -1 when the stream is drained.
+long dl_next(Loader* l, uint8_t* out, uint32_t cap) {
+  std::unique_lock<std::mutex> lk(l->mu);
+  l->cv_pop.wait(lk, [&] {
+    return !l->queue.empty() || l->live_workers.load() == 0;
+  });
+  if (l->queue.empty()) return -1;
+  auto rec = std::move(l->queue.front());
+  l->queue.pop_front();
+  l->cv_push.notify_one();
+  lk.unlock();
+  if (rec.size() > cap) return -2;
+  memcpy(out, rec.data(), rec.size());
+  return (long)rec.size();
+}
+
+void dl_close(Loader* l) {
+  if (!l) return;
+  l->stop = true;
+  {
+    std::lock_guard<std::mutex> lk(l->mu);
+    l->cv_push.notify_all();
+    l->cv_pop.notify_all();
+  }
+  for (auto& t : l->workers) t.join();
+  delete l;
+}
+
+}  // extern "C"

@@ -1,0 +1,346 @@
+// Master coordination service: fault-tolerant task dispatch.
+//
+// C++ rebuild of the Go master (reference: go/master/service.go —
+// todo/pending/done queues :280-:455, lease timeout + failure cap
+// processFailedTask :313, pass barriers, snapshot/recover :166-:207).
+// The Go version stored snapshots in etcd; this one snapshots to a
+// file (shared filesystem / object store in production) and keeps the
+// same recovery contract: a restarted master reloads the queues and
+// trainers just keep polling.
+//
+// Wire protocol: newline-delimited text over TCP (one connection per
+// trainer, requests are serialized per connection):
+//   PING                      -> PONG
+//   SET <n>\n<payload>*n      -> OK <n>         (set dataset tasks)
+//   GET                       -> TASK <id> <payload> | WAIT | ALL_DONE
+//   FIN <id>                  -> OK
+//   FAILTASK <id>             -> OK
+//   NEWPASS                   -> OK             (done -> todo, next pass)
+//   STATS                     -> STATS <todo> <pending> <done> <discarded>
+//   SNAP <path>               -> OK | ERR <msg>
+//   RECOVER <path>            -> OK | ERR <msg>
+//   SHUTDOWN                  -> OK
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <fstream>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Task {
+  long id;
+  std::string payload;
+  int failures = 0;
+};
+
+struct Pending {
+  Task task;
+  Clock::time_point deadline;
+};
+
+struct Master {
+  int port;
+  int lease_sec;
+  int failure_max;
+
+  std::mutex mu;
+  std::deque<Task> todo;
+  std::map<long, Pending> pending;
+  std::deque<Task> done;
+  long discarded = 0;
+  long next_id = 0;
+
+  int listen_fd = -1;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::thread timeout_thread;
+  std::vector<std::thread> conns;
+
+  // ---- task-queue core (mirrors go/master/service.go semantics) ----
+
+  std::string handle_get() {
+    std::lock_guard<std::mutex> lk(mu);
+    if (!todo.empty()) {
+      Task t = todo.front();
+      todo.pop_front();
+      pending[t.id] = {t, Clock::now() + std::chrono::seconds(lease_sec)};
+      return "TASK " + std::to_string(t.id) + " " + t.payload;
+    }
+    if (!pending.empty()) return "WAIT";
+    return "ALL_DONE";
+  }
+
+  std::string handle_fin(long id) {
+    std::lock_guard<std::mutex> lk(mu);
+    auto it = pending.find(id);
+    if (it == pending.end()) return "ERR unknown-or-expired " + std::to_string(id);
+    done.push_back(it->second.task);
+    pending.erase(it);
+    return "OK";
+  }
+
+  void fail_task_locked(Task t) {
+    t.failures++;
+    if (t.failures >= failure_max) {
+      discarded++;  // reference: discard after failureMax (service.go:311-330)
+    } else {
+      todo.push_back(t);
+    }
+  }
+
+  std::string handle_fail(long id) {
+    std::lock_guard<std::mutex> lk(mu);
+    auto it = pending.find(id);
+    if (it == pending.end()) return "ERR unknown-or-expired " + std::to_string(id);
+    fail_task_locked(it->second.task);
+    pending.erase(it);
+    return "OK";
+  }
+
+  void scan_timeouts() {
+    std::lock_guard<std::mutex> lk(mu);
+    auto now = Clock::now();
+    for (auto it = pending.begin(); it != pending.end();) {
+      if (it->second.deadline <= now) {
+        fail_task_locked(it->second.task);
+        it = pending.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+
+  std::string handle_newpass() {
+    std::lock_guard<std::mutex> lk(mu);
+    for (auto& t : done) {
+      t.failures = 0;
+      todo.push_back(t);
+    }
+    done.clear();
+    return "OK";
+  }
+
+  std::string snapshot(const std::string& path) {
+    std::lock_guard<std::mutex> lk(mu);
+    std::ofstream f(path, std::ios::trunc);
+    if (!f) return "ERR cannot-open";
+    f << next_id << " " << discarded << "\n";
+    auto dump = [&](const char* tag, const Task& t) {
+      f << tag << " " << t.id << " " << t.failures << " " << t.payload << "\n";
+    };
+    for (auto& t : todo) dump("T", t);
+    for (auto& kv : pending) dump("T", kv.second.task);  // pending re-queues
+    for (auto& t : done) dump("D", t);
+    return f.good() ? "OK" : "ERR write";
+  }
+
+  std::string recover(const std::string& path) {
+    std::lock_guard<std::mutex> lk(mu);
+    std::ifstream f(path);
+    if (!f) return "ERR cannot-open";
+    todo.clear();
+    pending.clear();
+    done.clear();
+    f >> next_id >> discarded;
+    std::string line;
+    std::getline(f, line);
+    while (std::getline(f, line)) {
+      if (line.size() < 2) continue;
+      std::istringstream ss(line);
+      std::string tag;
+      Task t;
+      ss >> tag >> t.id >> t.failures;
+      std::getline(ss, t.payload);
+      if (!t.payload.empty() && t.payload[0] == ' ') t.payload.erase(0, 1);
+      if (tag == "T")
+        todo.push_back(t);
+      else
+        done.push_back(t);
+    }
+    return "OK";
+  }
+
+  // ---- wire handling ----
+
+  void serve_conn(int fd) {
+    std::string buf;
+    char tmp[4096];
+    auto send_line = [&](const std::string& s) {
+      std::string out = s + "\n";
+      size_t off = 0;
+      while (off < out.size()) {
+        ssize_t n = ::send(fd, out.data() + off, out.size() - off, 0);
+        if (n <= 0) return false;
+        off += n;
+      }
+      return true;
+    };
+    auto read_line = [&](std::string* line) {
+      for (;;) {
+        auto pos = buf.find('\n');
+        if (pos != std::string::npos) {
+          *line = buf.substr(0, pos);
+          buf.erase(0, pos + 1);
+          return true;
+        }
+        ssize_t n = ::recv(fd, tmp, sizeof(tmp), 0);
+        if (n <= 0) return false;
+        buf.append(tmp, n);
+      }
+    };
+    std::string line;
+    while (!stop && read_line(&line)) {
+      std::istringstream ss(line);
+      std::string cmd;
+      ss >> cmd;
+      std::string resp;
+      if (cmd == "PING") {
+        resp = "PONG";
+      } else if (cmd == "SET") {
+        long n = 0;
+        ss >> n;
+        std::vector<std::string> payloads;
+        payloads.reserve(n);
+        bool ok = true;
+        for (long i = 0; i < n; i++) {
+          std::string p;
+          if (!read_line(&p)) {
+            ok = false;
+            break;
+          }
+          payloads.push_back(p);
+        }
+        if (!ok) break;
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          for (auto& p : payloads) todo.push_back({next_id++, p, 0});
+        }
+        resp = "OK " + std::to_string(n);
+      } else if (cmd == "GET") {
+        resp = handle_get();
+      } else if (cmd == "FIN") {
+        long id;
+        ss >> id;
+        resp = handle_fin(id);
+      } else if (cmd == "FAILTASK") {
+        long id;
+        ss >> id;
+        resp = handle_fail(id);
+      } else if (cmd == "NEWPASS") {
+        resp = handle_newpass();
+      } else if (cmd == "STATS") {
+        std::lock_guard<std::mutex> lk(mu);
+        resp = "STATS " + std::to_string(todo.size()) + " " +
+               std::to_string(pending.size()) + " " +
+               std::to_string(done.size()) + " " + std::to_string(discarded);
+      } else if (cmd == "SNAP") {
+        std::string p;
+        ss >> p;
+        resp = snapshot(p);
+      } else if (cmd == "RECOVER") {
+        std::string p;
+        ss >> p;
+        resp = recover(p);
+      } else if (cmd == "SHUTDOWN") {
+        send_line("OK");
+        stop = true;
+        break;
+      } else {
+        resp = "ERR unknown-command " + cmd;
+      }
+      if (!send_line(resp)) break;
+    }
+    ::close(fd);
+  }
+
+  bool start() {
+    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0) return false;
+    int one = 1;
+    setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = htons(port);
+    if (::bind(listen_fd, (sockaddr*)&addr, sizeof(addr)) < 0) return false;
+    if (port == 0) {
+      socklen_t len = sizeof(addr);
+      getsockname(listen_fd, (sockaddr*)&addr, &len);
+      port = ntohs(addr.sin_port);
+    }
+    if (::listen(listen_fd, 64) < 0) return false;
+
+    timeout_thread = std::thread([this] {
+      while (!stop) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(200));
+        scan_timeouts();
+      }
+    });
+    accept_thread = std::thread([this] {
+      while (!stop) {
+        int fd = ::accept(listen_fd, nullptr, nullptr);
+        if (fd < 0) {
+          if (stop) break;
+          continue;
+        }
+        conns.emplace_back([this, fd] { serve_conn(fd); });
+      }
+    });
+    return true;
+  }
+
+  void shutdown() {
+    stop = true;
+    if (listen_fd >= 0) {
+      ::shutdown(listen_fd, SHUT_RDWR);
+      ::close(listen_fd);
+    }
+    if (accept_thread.joinable()) accept_thread.join();
+    if (timeout_thread.joinable()) timeout_thread.join();
+    for (auto& t : conns)
+      if (t.joinable()) t.join();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+Master* master_start(int port, int lease_sec, int failure_max) {
+  auto* m = new Master();
+  m->port = port;
+  m->lease_sec = lease_sec > 0 ? lease_sec : 10;
+  m->failure_max = failure_max > 0 ? failure_max : 3;
+  if (!m->start()) {
+    delete m;
+    return nullptr;
+  }
+  return m;
+}
+
+int master_port(Master* m) { return m ? m->port : -1; }
+
+void master_stop(Master* m) {
+  if (!m) return;
+  m->shutdown();
+  delete m;
+}
+
+}  // extern "C"

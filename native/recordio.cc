@@ -1,0 +1,102 @@
+// RecordIO: CRC-framed record files.
+//
+// The reference's Go master shards datasets into RecordIO chunks
+// (go/master/service.go SetDataset; recordio dependency) and its
+// pserver checkpoints carry CRC32 integrity checks
+// (go/pserver/service.go:119-156).  This is the C++ equivalent used by
+// the native data loader and checkpoint paths.
+//
+// Format: file := record*; record := u32 len | u32 crc32(payload) | payload.
+// Little-endian, no compression (XLA feeds want raw bytes fast).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+uint32_t crc_table[256];
+bool crc_init_done = false;
+
+void crc_init() {
+  if (crc_init_done) return;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = c & 1 ? 0xedb88320u ^ (c >> 1) : c >> 1;
+    crc_table[i] = c;
+  }
+  crc_init_done = true;
+}
+
+uint32_t crc32(const uint8_t* buf, size_t len) {
+  crc_init();
+  uint32_t c = 0xffffffffu;
+  for (size_t i = 0; i < len; i++) c = crc_table[(c ^ buf[i]) & 0xff] ^ (c >> 8);
+  return c ^ 0xffffffffu;
+}
+
+}  // namespace
+
+extern "C" {
+
+struct RecordWriter {
+  FILE* f;
+};
+
+struct RecordReader {
+  FILE* f;
+  std::vector<uint8_t> buf;
+};
+
+RecordWriter* recordio_writer_open(const char* path) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  return new RecordWriter{f};
+}
+
+int recordio_write(RecordWriter* w, const uint8_t* data, uint32_t len) {
+  uint32_t crc = crc32(data, len);
+  if (fwrite(&len, 4, 1, w->f) != 1) return -1;
+  if (fwrite(&crc, 4, 1, w->f) != 1) return -1;
+  if (len && fwrite(data, 1, len, w->f) != len) return -1;
+  return 0;
+}
+
+void recordio_writer_close(RecordWriter* w) {
+  if (w) {
+    fclose(w->f);
+    delete w;
+  }
+}
+
+RecordReader* recordio_reader_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  return new RecordReader{f, {}};
+}
+
+// Returns record length, -1 on EOF, -2 on corruption (CRC mismatch).
+long recordio_read(RecordReader* r, uint8_t* out, uint32_t cap) {
+  uint32_t len, crc;
+  if (fread(&len, 4, 1, r->f) != 1) return -1;
+  if (fread(&crc, 4, 1, r->f) != 1) return -2;
+  if (len > cap) {
+    // skip oversized record, report corruption-style error
+    fseek(r->f, len, SEEK_CUR);
+    return -3;
+  }
+  if (len && fread(out, 1, len, r->f) != len) return -2;
+  if (crc32(out, len) != crc) return -2;
+  return (long)len;
+}
+
+void recordio_reader_close(RecordReader* r) {
+  if (r) {
+    fclose(r->f);
+    delete r;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,6 @@
+"""Distributed runtime: master service + client (the C++ replacement
+for the reference's Go master/pserver runtime, SURVEY.md §2.4) and the
+SPMD collective configuration (paddle_tpu.parallel)."""
+
+from paddle_tpu.distributed.master import MasterServer
+from paddle_tpu.distributed.master_client import MasterClient

@@ -1,0 +1,58 @@
+"""In-process handle on the native master service (reference:
+go/cmd/master/master.go for the standalone binary; go/master/service.go
+for semantics).  Run standalone:  python -m paddle_tpu.distributed.master
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+
+class MasterServer:
+    """Starts the C++ task-dispatch service on localhost."""
+
+    def __init__(self, port: int = 0, lease_sec: int = 10, failure_max: int = 3):
+        from paddle_tpu.native import lib
+
+        self._lib = lib()
+        self._h = self._lib.master_start(port, lease_sec, failure_max)
+        if not self._h:
+            raise RuntimeError("failed to start master service")
+        self.port = self._lib.master_port(self._h)
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def stop(self):
+        if self._h:
+            self._lib.master_stop(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+
+
+def main():
+    import argparse
+    import time
+
+    ap = argparse.ArgumentParser(description="paddle_tpu master service")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--lease-sec", type=int, default=10)
+    ap.add_argument("--failure-max", type=int, default=3)
+    args = ap.parse_args()
+    srv = MasterServer(args.port, args.lease_sec, args.failure_max)
+    print(f"master listening on {srv.address}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    main()

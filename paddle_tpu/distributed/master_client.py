@@ -1,0 +1,127 @@
+"""Trainer-side master client (reference: go/master/client.go
+Client.NextRecord / GetTask loop, surfaced in python via
+v2/master/client.py).  Speaks the line protocol of
+native/master_service.cc."""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Iterator, List, Optional, Sequence
+
+
+class MasterClient:
+    def __init__(self, address: str, timeout: float = 30.0):
+        host, port = address.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self._timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._rfile = None
+
+    # -- wire ---------------------------------------------------------------
+
+    def _connect(self):
+        if self._sock is not None:
+            return
+        s = socket.create_connection(self._addr, timeout=self._timeout)
+        self._sock = s
+        self._rfile = s.makefile("rb")
+
+    def _call(self, line: str, extra_lines: Sequence[str] = ()) -> str:
+        for attempt in range(3):
+            try:
+                self._connect()
+                payload = line + "\n" + "".join(e + "\n" for e in extra_lines)
+                self._sock.sendall(payload.encode())
+                resp = self._rfile.readline()
+                if not resp:
+                    raise ConnectionError("master closed connection")
+                return resp.decode().rstrip("\n")
+            except (OSError, ConnectionError):
+                # reconnect-with-retry (reference: go/connection/conn.go)
+                self.close()
+                if attempt == 2:
+                    raise
+                time.sleep(0.2 * (attempt + 1))
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+            self._rfile = None
+
+    # -- api ----------------------------------------------------------------
+
+    def ping(self) -> bool:
+        return self._call("PING") == "PONG"
+
+    def set_dataset(self, payloads: Sequence[str]):
+        resp = self._call(f"SET {len(payloads)}", payloads)
+        assert resp.startswith("OK"), resp
+
+    def get_task(self):
+        """Returns (task_id, payload), or None to retry later, or
+        StopIteration-sentinel 'ALL_DONE'."""
+        resp = self._call("GET")
+        if resp == "WAIT":
+            return None
+        if resp == "ALL_DONE":
+            return "ALL_DONE"
+        tag, tid, payload = resp.split(" ", 2)
+        assert tag == "TASK", resp
+        return int(tid), payload
+
+    def task_finished(self, task_id: int):
+        self._call(f"FIN {task_id}")
+
+    def task_failed(self, task_id: int):
+        self._call(f"FAILTASK {task_id}")
+
+    def new_pass(self):
+        self._call("NEWPASS")
+
+    def stats(self):
+        parts = self._call("STATS").split()
+        return {"todo": int(parts[1]), "pending": int(parts[2]),
+                "done": int(parts[3]), "discarded": int(parts[4])}
+
+    def snapshot(self, path: str):
+        assert self._call(f"SNAP {path}") == "OK"
+
+    def recover(self, path: str):
+        assert self._call(f"RECOVER {path}") == "OK"
+
+    def shutdown(self):
+        try:
+            self._call("SHUTDOWN")
+        except (OSError, ConnectionError):
+            pass
+        self.close()
+
+    # -- record streaming (NextRecord equivalent) ---------------------------
+
+    def records(self, shard_paths: Optional[List[str]] = None,
+                poll_interval: float = 0.1) -> Iterator[bytes]:
+        """Stream records from leased recordio-shard tasks, marking tasks
+        finished after their shard is fully consumed (reference:
+        go/master/client.go:240 NextRecord)."""
+        from paddle_tpu.native import RecordIOReader
+
+        while True:
+            task = self.get_task()
+            if task == "ALL_DONE":
+                return
+            if task is None:
+                time.sleep(poll_interval)
+                continue
+            tid, payload = task
+            try:
+                for rec in RecordIOReader(payload):
+                    yield rec
+            except Exception:
+                self.task_failed(tid)
+                continue
+            self.task_finished(tid)

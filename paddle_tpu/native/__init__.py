@@ -1,0 +1,154 @@
+"""Native runtime bindings: builds native/*.cc into a shared library on
+first use (g++ only — no pybind11 in this image) and exposes it via
+ctypes.  Components: recordio, data loader, master service."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_SRC_DIR, "libpaddle_tpu_native.so")
+_SOURCES = ["recordio.cc", "data_loader.cc", "master_service.cc"]
+
+_lock = threading.Lock()
+_lib = None
+
+
+def _build():
+    srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
+    newest_src = max(os.path.getmtime(s) for s in srcs)
+    if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= newest_src:
+        return
+    cmd = ["g++", "-std=c++17", "-O2", "-shared", "-fPIC", "-pthread",
+           "-o", _LIB_PATH] + srcs
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def lib() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is None:
+            _build()
+            l = ctypes.CDLL(_LIB_PATH)
+            # recordio
+            l.recordio_writer_open.restype = ctypes.c_void_p
+            l.recordio_writer_open.argtypes = [ctypes.c_char_p]
+            l.recordio_write.restype = ctypes.c_int
+            l.recordio_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_uint32]
+            l.recordio_writer_close.argtypes = [ctypes.c_void_p]
+            l.recordio_reader_open.restype = ctypes.c_void_p
+            l.recordio_reader_open.argtypes = [ctypes.c_char_p]
+            l.recordio_read.restype = ctypes.c_long
+            l.recordio_read.argtypes = [ctypes.c_void_p,
+                                        ctypes.POINTER(ctypes.c_uint8),
+                                        ctypes.c_uint32]
+            l.recordio_reader_close.argtypes = [ctypes.c_void_p]
+            # loader
+            l.dl_open.restype = ctypes.c_void_p
+            l.dl_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+                                  ctypes.c_int]
+            l.dl_next.restype = ctypes.c_long
+            l.dl_next.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_uint8),
+                                  ctypes.c_uint32]
+            l.dl_close.argtypes = [ctypes.c_void_p]
+            # master
+            l.master_start.restype = ctypes.c_void_p
+            l.master_start.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
+            l.master_port.restype = ctypes.c_int
+            l.master_port.argtypes = [ctypes.c_void_p]
+            l.master_stop.argtypes = [ctypes.c_void_p]
+            _lib = l
+    return _lib
+
+
+class RecordIOWriter:
+    def __init__(self, path: str):
+        self._lib = lib()
+        self._h = self._lib.recordio_writer_open(path.encode())
+        if not self._h:
+            raise IOError(f"cannot open {path}")
+
+    def write(self, data: bytes):
+        if self._lib.recordio_write(self._h, data, len(data)) != 0:
+            raise IOError("recordio write failed")
+
+    def close(self):
+        if self._h:
+            self._lib.recordio_writer_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+class RecordIOReader:
+    def __init__(self, path: str, max_record: int = 16 << 20):
+        self._lib = lib()
+        self._h = self._lib.recordio_reader_open(path.encode())
+        if not self._h:
+            raise IOError(f"cannot open {path}")
+        self._buf = (ctypes.c_uint8 * max_record)()
+        self._cap = max_record
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> bytes:
+        n = self._lib.recordio_read(self._h, self._buf, self._cap)
+        if n == -1:
+            self.close()
+            raise StopIteration
+        if n < 0:
+            raise IOError(f"corrupt record (code {n})")
+        return bytes(bytearray(self._buf[: n]))
+
+    def close(self):
+        if self._h:
+            self._lib.recordio_reader_close(self._h)
+            self._h = None
+
+
+class DataLoader:
+    """Prefetching reader over recordio shards (native threads)."""
+
+    def __init__(self, paths, num_threads: int = 2, capacity: int = 256,
+                 max_record: int = 16 << 20):
+        self._lib = lib()
+        csv = ",".join(paths).encode()
+        self._h = self._lib.dl_open(csv, num_threads, capacity, max_record)
+        self._buf = (ctypes.c_uint8 * max_record)()
+        self._cap = max_record
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> bytes:
+        n = self._lib.dl_next(self._h, self._buf, self._cap)
+        if n == -1:
+            raise StopIteration
+        if n < 0:
+            raise IOError("record larger than buffer")
+        return bytes(bytearray(self._buf[: n]))
+
+    def close(self):
+        if self._h:
+            self._lib.dl_close(self._h)
+            self._h = None
+
+    def reader(self):
+        """v2-style reader factory."""
+
+        def _r():
+            for rec in self:
+                yield rec
+
+        return _r
