@@ -221,6 +221,7 @@ class Executor:
         )
 
         from paddle_tpu import amp
+        from paddle_tpu import pallas as pk
 
         key = (
             self._program_key(program),
@@ -229,6 +230,7 @@ class Executor:
             self.place,
             id(self.strategy),
             amp.is_enabled(),
+            pk.is_enabled(),
         )
         compiled = self._cache.get(key)
         if compiled is None:

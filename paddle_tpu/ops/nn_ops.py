@@ -200,6 +200,15 @@ def _dropout(ctx):
 def _softmax(ctx):
     unary_in = ctx.input("X")
     x = unwrap(unary_in)
+    from paddle_tpu import pallas as pk
+
+    if pk.is_enabled() and x.ndim == 2:
+        from paddle_tpu.pallas import softmax as pk_sm
+
+        if pk_sm.fits(x.shape[0], x.shape[1]):
+            ctx.set_output("Out", rewrap(
+                unary_in, pk.pallas_softmax(x, interpret=pk.interpret_mode())))
+            return
     ctx.set_output("Out", rewrap(unary_in, jax.nn.softmax(x, axis=-1)))
 
 

@@ -1,0 +1,37 @@
+"""Hand-written Pallas TPU kernels for hot ops (north star: the
+reference's hand-written CUDA kernels — paddle/operators/math/*.cu,
+paddle/cuda/src/hl_cuda_lstm.cu etc. — reimplemented for the MXU/VPU).
+
+Kernels are opt-in (``enable()`` or PADDLE_TPU_USE_PALLAS=1): the XLA
+lowerings are already fused and fast, so each kernel must earn its
+place; they also run under ``interpret=True`` on CPU for numerics
+tests.  Op lowerings consult ``use_for(shape)`` and fall back to jnp
+whenever a shape doesn't tile cleanly."""
+
+from __future__ import annotations
+
+import os
+
+_STATE = {
+    "enabled": os.environ.get("PADDLE_TPU_USE_PALLAS", "0") == "1",
+    "interpret": os.environ.get("PADDLE_TPU_PALLAS_INTERPRET", "0") == "1",
+}
+
+
+def enable(flag: bool = True, interpret: bool | None = None):
+    _STATE["enabled"] = bool(flag)
+    if interpret is not None:
+        _STATE["interpret"] = bool(interpret)
+
+
+def is_enabled() -> bool:
+    return _STATE["enabled"]
+
+
+def interpret_mode() -> bool:
+    return _STATE["interpret"]
+
+
+from paddle_tpu.pallas.matmul import matmul as pallas_matmul  # noqa: E402
+from paddle_tpu.pallas.softmax import softmax as pallas_softmax  # noqa: E402
+from paddle_tpu.pallas.embedding import gather_rows as pallas_gather_rows  # noqa: E402

@@ -1,0 +1,77 @@
+"""Blocked MXU matmul kernel (reference analog: the cuBLAS path behind
+paddle/operators/math/math_function.cc gemm).
+
+Grid (M/bm, N/bn, K/bk); fp32 accumulation in VMEM scratch; bf16 or
+f32 operands.  K is innermost so the accumulator lives across the K
+steps of one (i, j) tile."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _mm_kernel(x_ref, y_ref, o_ref, acc_ref, *, k_steps):
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(
+        x_ref[:], y_ref[:], preferred_element_type=jnp.float32
+    )
+
+    @pl.when(pl.program_id(2) == k_steps - 1)
+    def _flush():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+
+def fits(m, k, n, bm=256, bk=512, bn=256) -> bool:
+    return m % bm == 0 and k % bk == 0 and n % bn == 0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def matmul(x, y, bm: int = 256, bk: int = 512, bn: int = 256,
+           interpret: bool = False):
+    return _matmul_impl(x, y, bm, bk, bn, interpret)
+
+
+def _matmul_fwd(x, y, bm, bk, bn, interpret):
+    return _matmul_impl(x, y, bm, bk, bn, interpret), (x, y)
+
+
+def _matmul_bwd(bm, bk, bn, interpret, res, g):
+    x, y = res
+    # dX = g @ Y^T, dY = X^T @ g — via XLA (transposed tilings differ)
+    gx = jnp.dot(g, y.T, preferred_element_type=jnp.float32).astype(x.dtype)
+    gy = jnp.dot(x.T, g, preferred_element_type=jnp.float32).astype(y.dtype)
+    return gx, gy
+
+
+matmul.defvjp(_matmul_fwd, _matmul_bwd)
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bk", "bn", "interpret"))
+def _matmul_impl(x, y, bm: int = 256, bk: int = 512, bn: int = 256,
+                 interpret: bool = False):
+    m, k = x.shape
+    k2, n = y.shape
+    assert k == k2 and fits(m, k, n, bm, bk, bn), (x.shape, y.shape)
+    k_steps = k // bk
+    return pl.pallas_call(
+        functools.partial(_mm_kernel, k_steps=k_steps),
+        grid=(m // bm, n // bn, k_steps),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(x, y)

@@ -1,0 +1,55 @@
+"""Fused row softmax kernel (reference analog: paddle/operators/math/
+softmax.cc + the cudnn softmax path): one pass per row block — max,
+exp, sum, divide — entirely in VMEM, single HBM read/write."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _softmax_kernel(x_ref, o_ref):
+    x = x_ref[:].astype(jnp.float32)
+    m = jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x - m)
+    o_ref[:] = (e / jnp.sum(e, axis=-1, keepdims=True)).astype(o_ref.dtype)
+
+
+def fits(rows, cols, block_rows=256) -> bool:
+    return rows % block_rows == 0 and cols % 128 == 0 and cols <= 16384
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def softmax(x, block_rows: int = 256, interpret: bool = False):
+    return _softmax_impl(x, block_rows, interpret)
+
+
+def _softmax_fwd(x, block_rows, interpret):
+    out = _softmax_impl(x, block_rows, interpret)
+    return out, out
+
+
+def _softmax_bwd(block_rows, interpret, out, g):
+    # d/dx softmax: s * (g - sum(g * s))
+    inner = jnp.sum(g * out, axis=-1, keepdims=True)
+    return (out * (g - inner),)
+
+
+softmax.defvjp(_softmax_fwd, _softmax_bwd)
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def _softmax_impl(x, block_rows: int = 256, interpret: bool = False):
+    rows, cols = x.shape
+    assert fits(rows, cols, block_rows), x.shape
+    return pl.pallas_call(
+        _softmax_kernel,
+        grid=(rows // block_rows,),
+        in_specs=[pl.BlockSpec((block_rows, cols), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((block_rows, cols), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=interpret,
+    )(x)

@@ -1,0 +1,87 @@
+"""Pallas kernel tests (interpret mode on CPU: numerics vs jnp, plus
+the op-lowering integration path with the flag on)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu import pallas as pk
+from paddle_tpu.pallas.embedding import gather_rows
+from paddle_tpu.pallas.matmul import matmul
+from paddle_tpu.pallas.softmax import softmax
+
+
+def test_matmul_kernel_numerics(rng):
+    x = rng.randn(512, 1024).astype("float32")
+    y = rng.randn(1024, 512).astype("float32")
+    got = np.asarray(matmul(jnp.asarray(x), jnp.asarray(y), interpret=True))
+    np.testing.assert_allclose(got, x @ y, atol=5e-3, rtol=1e-4)
+
+
+def test_matmul_kernel_grad(rng):
+    x = jnp.asarray(rng.randn(256, 512).astype("float32"))
+    y = jnp.asarray(rng.randn(512, 256).astype("float32"))
+
+    def loss(a, b):
+        return jnp.sum(matmul(a, b, 256, 512, 256, True) ** 2)
+
+    gx, gy = jax.grad(loss, argnums=(0, 1))(x, y)
+    want_gx = 2 * (np.asarray(x) @ np.asarray(y)) @ np.asarray(y).T
+    np.testing.assert_allclose(np.asarray(gx), want_gx, atol=1e-1, rtol=1e-3)
+
+
+def test_softmax_kernel_numerics(rng):
+    x = rng.randn(512, 256).astype("float32")
+    got = np.asarray(softmax(jnp.asarray(x), interpret=True))
+    e = np.exp(x - x.max(-1, keepdims=True))
+    np.testing.assert_allclose(got, e / e.sum(-1, keepdims=True), atol=1e-6)
+
+
+def test_gather_kernel(rng):
+    w = rng.randn(1000, 128).astype("float32")
+    ids = rng.randint(0, 1000, 64).astype("int32")
+    got = np.asarray(gather_rows(jnp.asarray(w), jnp.asarray(ids),
+                                 interpret=True))
+    np.testing.assert_allclose(got, w[ids])
+
+
+def test_op_lowering_uses_pallas_and_trains(rng):
+    """fc + softmax through the op path with pallas on (interpret):
+    forward matches flag-off run and gradients still flow."""
+    def build_and_run():
+        fluid.framework.reset_default_programs()
+        from paddle_tpu import executor as em
+
+        em._global_scope = em.Scope()
+        em._scope_stack = [em._global_scope]
+        x = fluid.layers.data(name="x", shape=[512], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=256, bias_attr=False,
+                            param_attr=fluid.param_attr.ParamAttr(
+                                initializer=fluid.initializer.Constant(0.01)))
+        sm = fluid.layers.softmax(h)
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(input=sm, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        xs = rng.randn(256, 512).astype("float32")
+        ys = np.zeros((256, 1), "int64")
+        (l1,) = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+        (l2,) = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+        return float(l1), float(l2)
+
+    pk.enable(False)
+    base = build_and_run()
+    rng2 = np.random.RandomState(42)
+    try:
+        pk.enable(True, interpret=True)
+        rng.seed(42)
+        with_pallas = build_and_run()
+    finally:
+        pk.enable(False, interpret=False)
+    np.testing.assert_allclose(base[0], with_pallas[0], atol=1e-4)
+    # loss decreased in both modes (grads flowed through custom vjp)
+    assert with_pallas[1] < with_pallas[0]
