@@ -231,6 +231,7 @@ class Executor:
             id(self.strategy),
             amp.is_enabled(),
             pk.is_enabled(),
+            pk.interpret_mode(),
         )
         compiled = self._cache.get(key)
         if compiled is None:
@@ -365,14 +366,20 @@ class Executor:
         written_names = tuple(written_state)
         ops = [op for op in block.ops if op.type not in ("feed", "fetch")]
 
+        strategy = self.strategy
+
         def run_block(state, feeds, seed=None):
+            from paddle_tpu.parallel.strategy import strategy_scope
+
             values: Dict[str, Any] = {}
             values.update(state)
             values.update(feeds)
             rng = RngState(jax.random.key(seed)) if seed is not None else None
-            for op in ops:
-                info = OpRegistry.get(op.type)
-                info.lower(LowerContext(op, values, rng=rng, executor_ctx=program))
+            with strategy_scope(strategy):
+                for op in ops:
+                    info = OpRegistry.get(op.type)
+                    info.lower(LowerContext(op, values, rng=rng,
+                                            executor_ctx=program))
             fetches = [values[n] for n in fetch_names]
             new_state = {n: values[n] for n in out_state_names}
             return fetches, new_state

@@ -164,6 +164,9 @@ class Variable:
         self.type = type
         # set lazily by layers that want an init op appended to startup
         self.initializer = initializer
+        # optional sharding hint (PartitionSpec-shaped tuple) for
+        # parallel strategies; set via ParamAttr(shard=...)
+        self.dist_spec = None
 
     # convenience mirroring the reference API
     @property

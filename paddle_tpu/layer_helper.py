@@ -65,6 +65,13 @@ class LayerHelper:
                 name=attr.name, shape=shape, dtype=dtype, persistable=True
             )
             init(svar, sblock)
+        if getattr(attr, "shard", None) is not None:
+            param.dist_spec = attr.shard
+            # mirror onto the startup-program var so the startup run
+            # already places shards correctly (no post-hoc reshard)
+            sv = sblock.vars.get(attr.name)
+            if sv is not None:
+                sv.dist_spec = attr.shard
         return param
 
     def create_tmp_variable(self, dtype, shape=None, lod_level=0) -> Variable:

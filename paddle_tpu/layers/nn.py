@@ -27,6 +27,9 @@ __all__ = [
     "dynamic_lstm",
     "matmul",
     "lrn",
+    "layer_norm",
+    "scaled_dot_product_attention",
+    "multi_head_attention",
 ]
 
 
@@ -95,6 +98,110 @@ def embedding(input, size, is_sparse: bool = False, padding_idx=None,
         attrs={"is_sparse": is_sparse, "padding_idx": padding_idx},
     )
     return out
+
+
+def layer_norm(input, scale: bool = True, shift: bool = True,
+               begin_norm_axis: int = 1, epsilon: float = 1e-5,
+               param_attr=None, bias_attr=None, act=None, name=None,
+               **kwargs):
+    """LayerNorm over dims [begin_norm_axis:) (op: attention_ops.py)."""
+    helper = LayerHelper("layer_norm", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name, **kwargs)
+    dtype = input.dtype
+    norm_shape = [int(s) for s in input.shape[begin_norm_axis:]]
+    inputs = {"X": [input]}
+    if scale:
+        g = helper.create_parameter(
+            param_attr, shape=norm_shape, dtype=dtype,
+            default_initializer=ConstantInitializer(1.0))
+        inputs["Scale"] = [g]
+    if shift:
+        b = helper.create_parameter(bias_attr, shape=norm_shape, dtype=dtype,
+                                    is_bias=True)
+        inputs["Bias"] = [b]
+    out = helper.create_tmp_variable(dtype, input.shape, input.lod_level)
+    mean = helper.create_tmp_variable("float32", input.shape[:begin_norm_axis])
+    var = helper.create_tmp_variable("float32", input.shape[:begin_norm_axis])
+    helper.append_op(
+        type="layer_norm", inputs=inputs,
+        outputs={"Y": [out], "Mean": [mean], "Variance": [var]},
+        attrs={"epsilon": epsilon, "begin_norm_axis": begin_norm_axis},
+    )
+    return helper.append_activation(out)
+
+
+def scaled_dot_product_attention(q, k, v, causal: bool = False, name=None,
+                                 **kwargs):
+    """q,k,v: (B, S, H, D).  Ring attention under a sequence-parallel
+    strategy; fused MXU attention otherwise."""
+    helper = LayerHelper("sdp_attention", name=name, **kwargs)
+    out = helper.create_tmp_variable(q.dtype, q.shape, q.lod_level)
+    helper.append_op(
+        type="scaled_dot_product_attention",
+        inputs={"Q": [q], "K": [k], "V": [v]},
+        outputs={"Out": [out]},
+        attrs={"causal": causal},
+    )
+    return out
+
+
+def multi_head_attention(input, num_heads: int, causal: bool = False,
+                         param_attr=None, tp_axis: Optional[str] = None,
+                         name=None, **kwargs):
+    """Self-attention block: qkv projection -> scaled-dot-product (ring
+    under SP) -> output projection.  input: (B, S, d_model).
+
+    ``tp_axis`` annotates the projections Megatron-style (qkv column-
+    parallel, output row-parallel) so a TensorParallel/Hybrid strategy
+    shards heads over that mesh axis with a single all-reduce at the
+    output projection (inserted by GSPMD).
+    """
+    from paddle_tpu.param_attr import ParamAttr
+
+    B, S, d = input.shape
+    assert d % num_heads == 0, (d, num_heads)
+    head_dim = d // num_heads
+
+    def _shard(attr, spec):
+        attr = ParamAttr.to_attr(attr)
+        import copy
+        attr = copy.copy(attr)
+        if tp_axis is not None and attr.shard is None:
+            attr.shard = spec
+        return attr
+
+    qkv = fc(input, 3 * d, num_flatten_dims=2,
+             param_attr=_shard(param_attr, (None, tp_axis)),
+             bias_attr=False, name=name and name + "_qkv", **kwargs)
+    helper = LayerHelper("mha", name=name, **kwargs)
+    q = helper.create_tmp_variable(input.dtype, (B, S, d))
+    k = helper.create_tmp_variable(input.dtype, (B, S, d))
+    v = helper.create_tmp_variable(input.dtype, (B, S, d))
+    helper.append_op(
+        type="split", inputs={"X": [qkv]},
+        outputs={"Out": [q, k, v]},
+        attrs={"num": 3, "axis": 2},
+    )
+    for t in (q, k, v):
+        rs = helper.create_tmp_variable(input.dtype,
+                                        (B, S, num_heads, head_dim))
+        helper.append_op(type="reshape", inputs={"X": [t]},
+                         outputs={"Out": [rs]},
+                         attrs={"shape": [0, 0, num_heads, head_dim]})
+        if t is q:
+            q = rs
+        elif t is k:
+            k = rs
+        else:
+            v = rs
+    ctx_out = scaled_dot_product_attention(q, k, v, causal=causal, **kwargs)
+    merged = helper.create_tmp_variable(input.dtype, (B, S, d))
+    helper.append_op(type="reshape", inputs={"X": [ctx_out]},
+                     outputs={"Out": [merged]},
+                     attrs={"shape": [0, 0, d]})
+    return fc(merged, d, num_flatten_dims=2,
+              param_attr=_shard(param_attr, (tp_axis, None)),
+              bias_attr=False, name=name and name + "_proj", **kwargs)
 
 
 def _conv_out_size(size, k, p, s, d=1):

@@ -7,3 +7,8 @@ from paddle_tpu.models.lenet import lenet5
 from paddle_tpu.models.vgg import vgg16
 from paddle_tpu.models.alexnet import alexnet
 from paddle_tpu.models.lstm_text import lstm_text_classifier
+from paddle_tpu.models.transformer import (
+    transformer_lm,
+    transformer_lm_loss,
+    transformer_lm_pipelined,
+)

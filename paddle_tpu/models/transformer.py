@@ -1,0 +1,137 @@
+"""Decoder-only Transformer LM — the long-context / hybrid-parallel
+flagship.
+
+The reference era (PaddlePaddle v0.11.0) tops out at seq2seq with
+additive attention (gserver RecurrentGradientMachine beam search;
+fluid book test_machine_translation); this model is the TPU-native
+capability extension: causal multi-head attention that runs as ring
+attention over an ``sp`` mesh axis (sequence/context parallelism),
+Megatron-style tensor-parallel projections over a ``tp`` axis, and
+batch sharding over ``dp`` — all on one jax.sharding.Mesh, with GSPMD
+inserting the ICI collectives.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu import layers
+from paddle_tpu.initializer import NormalInitializer
+from paddle_tpu.param_attr import ParamAttr
+
+
+def transformer_lm(tokens, vocab_size: int, d_model: int = 256,
+                   num_heads: int = 8, num_layers: int = 2,
+                   ffn_mult: int = 4, seq_len: int = None,
+                   tp_axis: str = None, causal: bool = True):
+    """tokens: (B, S, 1) int64 -> logits (B*S, vocab_size).
+
+    ``tp_axis``: mesh axis name for Megatron TP sharding hints (ignored
+    when running unsharded).
+    """
+    S = int(tokens.shape[1]) if seq_len is None else seq_len
+    x = layers.embedding(
+        tokens, size=[vocab_size, d_model],
+        param_attr=ParamAttr(name="tok_emb",
+                             initializer=NormalInitializer(0.0, 0.02),
+                             shard=(None, tp_axis) if tp_axis else None))
+    # learned positional embedding, broadcast over batch
+    from paddle_tpu.layer_helper import LayerHelper
+    from paddle_tpu.layers.tensor import elementwise_add
+
+    h = LayerHelper("pos_emb")
+    pos = h.create_parameter(
+        ParamAttr(name="pos_emb", initializer=NormalInitializer(0.0, 0.02)),
+        shape=[S, d_model], dtype=x.dtype)
+    x = elementwise_add(x, pos, axis=1)
+
+    for i in range(num_layers):
+        ln1 = layers.layer_norm(x, begin_norm_axis=2, name=f"ln1_{i}")
+        att = layers.multi_head_attention(
+            ln1, num_heads=num_heads, causal=causal, tp_axis=tp_axis,
+            name=f"attn_{i}")
+        res1 = elementwise_add(x, att)
+        ln2 = layers.layer_norm(res1, begin_norm_axis=2, name=f"ln2_{i}")
+        ff1 = layers.fc(ln2, d_model * ffn_mult, num_flatten_dims=2,
+                        act="relu", name=f"ffn1_{i}",
+                        param_attr=ParamAttr(shard=(None, tp_axis))
+                        if tp_axis else None)
+        ff2 = layers.fc(ff1, d_model, num_flatten_dims=2, name=f"ffn2_{i}",
+                        param_attr=ParamAttr(shard=(tp_axis, None))
+                        if tp_axis else None)
+        x = elementwise_add(res1, ff2)
+
+    x = layers.layer_norm(x, begin_norm_axis=2, name="ln_f")
+    from paddle_tpu.layers.tensor import reshape
+
+    flat = reshape(x, shape=[-1, d_model])
+    logits = layers.fc(flat, vocab_size, name="lm_head",
+                       param_attr=ParamAttr(shard=(None, tp_axis))
+                       if tp_axis else None, bias_attr=False)
+    return logits
+
+
+def transformer_lm_pipelined(tokens, vocab_size: int, d_model: int = 256,
+                             num_heads: int = 8, num_layers: int = 4,
+                             ffn_mult: int = 4, seq_len: int = None,
+                             pp_axis: str = None, n_microbatch: int = 2,
+                             causal: bool = True):
+    """Pipeline-parallel variant: the L blocks' params are stacked
+    (L, ...) and sharded over ``pp_axis``; one op runs the GPipe
+    schedule (ops/pipeline_ops.py).  tokens: (B, S, 1) int64."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    S = int(tokens.shape[1]) if seq_len is None else seq_len
+    d, L, f = d_model, num_layers, d_model * ffn_mult
+    x = layers.embedding(
+        tokens, size=[vocab_size, d],
+        param_attr=ParamAttr(name="tok_emb",
+                             initializer=NormalInitializer(0.0, 0.02)))
+    from paddle_tpu.layers.tensor import elementwise_add
+
+    h = LayerHelper("pipe_tf")
+    pos = h.create_parameter(
+        ParamAttr(name="pos_emb", initializer=NormalInitializer(0.0, 0.02)),
+        shape=[S, d], dtype=x.dtype)
+    x = elementwise_add(x, pos, axis=1)
+
+    def stacked(name, shape, init=None, one=False):
+        from paddle_tpu.initializer import ConstantInitializer
+        ini = init or (ConstantInitializer(1.0) if one
+                       else NormalInitializer(0.0, 0.02))
+        return h.create_parameter(
+            ParamAttr(name=name, initializer=ini,
+                      shard=((pp_axis,) if pp_axis else None)),
+            shape=[L] + list(shape), dtype=x.dtype)
+
+    from paddle_tpu.initializer import ConstantInitializer
+    inputs = {
+        "X": [x],
+        "QKVW": [stacked("blk_qkvw", [d, 3 * d])],
+        "ProjW": [stacked("blk_projw", [d, d])],
+        "FF1W": [stacked("blk_ff1w", [d, f])],
+        "FF1B": [stacked("blk_ff1b", [f], init=ConstantInitializer(0.0))],
+        "FF2W": [stacked("blk_ff2w", [f, d])],
+        "FF2B": [stacked("blk_ff2b", [d], init=ConstantInitializer(0.0))],
+        "LN1S": [stacked("blk_ln1s", [d], one=True)],
+        "LN1B": [stacked("blk_ln1b", [d], init=ConstantInitializer(0.0))],
+        "LN2S": [stacked("blk_ln2s", [d], one=True)],
+        "LN2B": [stacked("blk_ln2b", [d], init=ConstantInitializer(0.0))],
+    }
+    out = h.create_tmp_variable(x.dtype, x.shape)
+    h.append_op(type="transformer_pipeline_blocks", inputs=inputs,
+                outputs={"Out": [out]},
+                attrs={"num_heads": num_heads, "causal": causal,
+                       "n_microbatch": n_microbatch})
+    from paddle_tpu.layers.tensor import reshape
+
+    flat = reshape(out, shape=[-1, d])
+    return layers.fc(flat, vocab_size, name="lm_head", bias_attr=False)
+
+
+def transformer_lm_loss(tokens, labels, **kw):
+    """labels: (B, S, 1) int64; returns scalar mean loss."""
+    logits = transformer_lm(tokens, **kw)
+    from paddle_tpu.layers.tensor import reshape
+
+    flat_labels = reshape(labels, shape=[-1, 1])
+    loss = layers.softmax_with_cross_entropy(logits, flat_labels)
+    return layers.mean(loss)

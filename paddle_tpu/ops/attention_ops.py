@@ -1,0 +1,72 @@
+"""Attention + normalization ops for the transformer/long-context path.
+
+The reference predates transformers (its attention is the seq2seq
+additive attention built from existing ops — see
+python/paddle/v2/fluid/tests/book/test_machine_translation.py-era
+models); a TPU-native framework makes fused scaled-dot-product
+attention a first-class op so that (a) XLA lowers it onto the MXU as
+two big batched matmuls and (b) under a sequence-parallel strategy it
+switches to ring attention over the mesh's ``sp`` axis
+(paddle_tpu/parallel/ring_attention.py) — the long-context scaling
+story the reference's LoD batching cannot provide.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.lod import rewrap, unwrap
+from paddle_tpu.registry import register_op
+
+
+@register_op("layer_norm", inputs=("X", "Scale", "Bias"),
+             outputs=("Y", "Mean", "Variance"))
+def _layer_norm(ctx):
+    x = unwrap(ctx.input("X"))
+    eps = ctx.attr("epsilon", 1e-5)
+    begin = ctx.attr("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(axis=axes, keepdims=True)
+    var = ((xf - mean) ** 2).mean(axis=axes, keepdims=True)
+    y = (xf - mean) / jnp.sqrt(var + eps)
+    if ctx.has_input("Scale"):
+        scale = unwrap(ctx.input("Scale")).astype(jnp.float32)
+        y = y * scale.reshape(x.shape[begin:])
+    if ctx.has_input("Bias"):
+        bias = unwrap(ctx.input("Bias")).astype(jnp.float32)
+        y = y + bias.reshape(x.shape[begin:])
+    ctx.set_output("Y", rewrap(ctx.input("X"), y.astype(x.dtype)))
+    ctx.set_output("Mean", mean.squeeze(axes))
+    ctx.set_output("Variance", var.squeeze(axes))
+
+
+@register_op("scaled_dot_product_attention", inputs=("Q", "K", "V"))
+def _sdp_attention(ctx):
+    """Q,K,V: (B, S, H, D) -> Out (B, S, H, D).
+
+    Under a strategy whose mesh has a sequence-parallel axis, lowers to
+    ring attention (K/V rotating over ICI via ppermute with online
+    softmax); otherwise a plain fused attention that XLA maps to two
+    batched MXU matmuls.
+    """
+    from paddle_tpu.parallel import strategy as strat
+    from paddle_tpu.parallel.ring_attention import (
+        local_attention, ring_attention_sharded)
+
+    q = unwrap(ctx.input("Q"))
+    k = unwrap(ctx.input("K"))
+    v = unwrap(ctx.input("V"))
+    causal = ctx.attr("causal", False)
+    # (B, S, H, D) -> (B, H, S, D)
+    qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    s = strat.current_strategy()
+    sp = getattr(s, "sp_axis", None) if s is not None else None
+    if sp is not None:
+        out = ring_attention_sharded(
+            s.mesh, sp, qt, kt, vt, causal=causal,
+            batch_axis=getattr(s, "dp_axis", None),
+            head_axis=getattr(s, "tp_axis", None))
+    else:
+        out = local_attention(qt, kt, vt, causal=causal)
+    ctx.set_output("Out", rewrap(ctx.input("Q"), out.transpose(0, 2, 1, 3)))

@@ -1,0 +1,81 @@
+"""Pipelined transformer-stack op: pp-axis GPipe schedule as one op.
+
+Reference capability analog: ParallelNeuralNetwork's per-layer device
+placement (gserver/gradientmachines/ParallelNeuralNetwork.h:34,61-63)
+— re-designed TPU-first: the L identical blocks' parameters are
+stacked (L, ...) and sharded over the mesh's ``pp`` axis; the lowering
+runs the GPipe microbatch schedule (parallel/pipeline.py) inside
+``shard_map``, composing with dp (batch) and sp (ring attention) axes
+of the same mesh.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.lod import rewrap, unwrap
+from paddle_tpu.registry import register_op
+
+_PARAM_SLOTS = ("QKVW", "ProjW", "FF1W", "FF1B", "FF2W", "FF2B",
+                "LN1S", "LN1B", "LN2S", "LN2B")
+
+
+def _ln(h, s, b, eps=1e-5):
+    hf = h.astype(jnp.float32)
+    m = hf.mean(-1, keepdims=True)
+    v = ((hf - m) ** 2).mean(-1, keepdims=True)
+    return ((hf - m) / jnp.sqrt(v + eps) * s + b).astype(h.dtype)
+
+
+def _make_block_fn(num_heads: int, causal: bool, sp_axis):
+    from paddle_tpu.parallel.ring_attention import (
+        local_attention, ring_attention)
+
+    def block(p, h):
+        qkvw, projw, ff1w, ff1b, ff2w, ff2b, ln1s, ln1b, ln2s, ln2b = p
+        Bm, S, d = h.shape
+        hd = d // num_heads
+        hn = _ln(h, ln1s, ln1b)
+        qkv = hn @ qkvw  # (Bm, S, 3d)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (t.reshape(Bm, S, num_heads, hd).transpose(0, 2, 1, 3)
+                   for t in (q, k, v))
+        if sp_axis is not None:
+            att = ring_attention(q, k, v, axis_name=sp_axis, causal=causal)
+        else:
+            att = local_attention(q, k, v, causal=causal)
+        att = att.transpose(0, 2, 1, 3).reshape(Bm, S, d) @ projw
+        h = h + att
+        hn2 = _ln(h, ln2s, ln2b)
+        f = jnp.maximum(hn2 @ ff1w + ff1b[None, None], 0.0) @ ff2w
+        return h + f + ff2b[None, None]
+
+    return block
+
+
+@register_op("transformer_pipeline_blocks",
+             inputs=("X",) + _PARAM_SLOTS, outputs=("Out",))
+def _transformer_pipeline_blocks(ctx):
+    from paddle_tpu.parallel import strategy as strat
+    from paddle_tpu.parallel.pipeline import gpipe
+
+    x = unwrap(ctx.input("X"))
+    params = tuple(unwrap(ctx.input(s)) for s in _PARAM_SLOTS)
+    num_heads = ctx.attr("num_heads")
+    causal = ctx.attr("causal", True)
+    n_microbatch = ctx.attr("n_microbatch", 1)
+
+    s = strat.current_strategy()
+    pp = getattr(s, "pp_axis", None) if s is not None else None
+    sp = getattr(s, "sp_axis", None) if s is not None else None
+    mesh = s.mesh if s is not None else None
+    block = _make_block_fn(num_heads, causal, sp if pp is not None else None)
+    if pp is None:
+        # unsharded / no pipeline axis: run the same stacked block scan
+        out = gpipe(block, params, x, mesh=None, pp_axis=None,
+                    n_microbatch=n_microbatch)
+    else:
+        out = gpipe(block, params, x, mesh=mesh, pp_axis=pp,
+                    n_microbatch=n_microbatch,
+                    batch_axis=getattr(s, "dp_axis", None), sp_axis=sp)
+    ctx.set_output("Out", rewrap(ctx.input("X"), out))

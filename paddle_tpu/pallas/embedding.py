@@ -29,12 +29,14 @@ def gather_rows(w, ids, interpret: bool = False):
 
 
 def _gather_fwd(w, ids, interpret):
-    return _gather_impl(w, ids, interpret), (ids, w.shape, w.dtype)
+    # residuals must be JAX types (a np.dtype is not): keep ids + the
+    # static shape; the cotangent g already has w's dtype (out = w[ids])
+    return _gather_impl(w, ids, interpret), (ids, w.shape)
 
 
 def _gather_bwd(interpret, res, g):
-    ids, wshape, wdtype = res
-    gw = jnp.zeros(wshape, wdtype).at[ids].add(g.astype(wdtype))
+    ids, wshape = res
+    gw = jnp.zeros(wshape, g.dtype).at[ids].add(g)
     return gw, None
 
 

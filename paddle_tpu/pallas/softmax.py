@@ -18,8 +18,12 @@ def _softmax_kernel(x_ref, o_ref):
     o_ref[:] = (e / jnp.sum(e, axis=-1, keepdims=True)).astype(o_ref.dtype)
 
 
-def fits(rows, cols, block_rows=256) -> bool:
-    return rows % block_rows == 0 and cols % 128 == 0 and cols <= 16384
+def fits(rows, cols, block_rows=256, itemsize=4) -> bool:
+    # VMEM budget: in block + out block + fp32 temps must coexist in
+    # ~16MB/core; cap a block's footprint at 2MB so 4-5 live copies fit
+    block_bytes = block_rows * cols * max(itemsize, 4)
+    return (rows % block_rows == 0 and cols % 128 == 0
+            and block_bytes <= 2 * 1024 * 1024)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))

@@ -8,6 +8,15 @@ TPU-native design: one compiled program, sharded over a
 
 from paddle_tpu.parallel.strategy import (
     DataParallelStrategy,
+    HybridParallelStrategy,
     Strategy,
+    TensorParallelStrategy,
+    current_strategy,
     make_mesh,
+    strategy_scope,
+)
+from paddle_tpu.parallel.ring_attention import (
+    local_attention,
+    ring_attention,
+    ring_attention_sharded,
 )

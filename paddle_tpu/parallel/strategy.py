@@ -8,16 +8,48 @@ shard every feed's batch dim over the mesh, replicate parameters, and
 let XLA turn the (replicated-out) gradient contractions into psum over
 ICI.  No gradient-merge thread, no parameter server: the collective is
 inside the step program.
+
+Tensor parallel (no reference equivalent — the closest is per-layer
+device placement in ParallelNeuralNetwork.h:34): parameters carry a
+``dist_spec`` (a PartitionSpec-shaped tuple set via
+``ParamAttr(shard=...)``); XLA/GSPMD propagates the sharding through
+the matmuls and inserts the all-reduce/all-gather where row/column
+parallel layers meet.
+
+Sequence parallel: the strategy exposes ``sp_axis``; feeds with a
+sequence dim shard it, and the ``scaled_dot_product_attention`` op
+lowers to ring attention over that axis
+(paddle_tpu/parallel/ring_attention.py).
 """
 
 from __future__ import annotations
 
+import contextlib
+import re
 from typing import Dict, Optional, Sequence
 
 import numpy as np
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+# --- current-strategy scope (read by op lowerings at trace time) -----------
+
+_current: list = [None]
+
+
+def current_strategy():
+    return _current[-1]
+
+
+@contextlib.contextmanager
+def strategy_scope(s):
+    _current.append(s)
+    try:
+        yield
+    finally:
+        _current.pop()
 
 
 def make_mesh(axis_sizes: Dict[str, int], devices=None) -> Mesh:
@@ -74,6 +106,7 @@ class DataParallelStrategy(Strategy):
     def __init__(self, mesh: Mesh, axis: str = "dp"):
         super().__init__(mesh)
         self.axis = axis
+        self.dp_axis = axis
 
     def feed_spec(self, name: str, var) -> P:
         from paddle_tpu.lod import LoDArray  # noqa: F401
@@ -82,3 +115,91 @@ class DataParallelStrategy(Strategy):
             # ragged packed rows don't shard on batch yet: replicate
             return P()
         return P(self.axis)
+
+
+def _spec_from_dist(dist_spec) -> P:
+    return P(*dist_spec) if dist_spec is not None else None
+
+
+class HybridParallelStrategy(Strategy):
+    """Multi-axis SPMD: dp x tp x sp (x ep via ShardedEmbedding) on one
+    mesh — the scaling-book recipe: annotate, let GSPMD insert the
+    collectives, ICI carries them.
+
+    - ``dp_axis``: feeds shard dim 0.
+    - ``tp_axis``: parameters shard per their ``dist_spec`` (from
+      ``ParamAttr(shard=...)``); optimizer accumulators inherit the
+      spec of the parameter whose name prefixes theirs.
+    - ``sp_axis``: feeds listed in ``seq_feeds`` (or all rank>=2 feeds
+      when ``shard_all_seq``) shard dim 1; the attention op switches to
+      ring attention over this axis.
+    - ``feed_specs``: explicit per-feed PartitionSpec overrides.
+    """
+
+    def __init__(self, mesh: Mesh, dp_axis: Optional[str] = "dp",
+                 tp_axis: Optional[str] = None, sp_axis: Optional[str] = None,
+                 pp_axis: Optional[str] = None,
+                 feed_specs: Optional[Dict[str, P]] = None,
+                 seq_feeds: Sequence[str] = (), shard_all_seq: bool = False,
+                 param_rules: Sequence = ()):
+        super().__init__(mesh)
+        axes = set(mesh.axis_names)
+        for a in (dp_axis, tp_axis, sp_axis, pp_axis):
+            assert a is None or a in axes, f"axis {a!r} not in mesh {axes}"
+        self.dp_axis = dp_axis
+        self.tp_axis = tp_axis
+        self.sp_axis = sp_axis
+        self.pp_axis = pp_axis
+        self.feed_specs = dict(feed_specs or {})
+        self.seq_feeds = set(seq_feeds)
+        self.shard_all_seq = shard_all_seq
+        # (regex, spec-tuple) fallbacks for params without dist_spec
+        self.param_rules = [(re.compile(p), s) for p, s in param_rules]
+
+    def _param_spec(self, name: str, var) -> Optional[P]:
+        ds = getattr(var, "dist_spec", None) if var is not None else None
+        if ds is not None:
+            return _spec_from_dist(ds)
+        for rx, spec in self.param_rules:
+            if rx.search(name):
+                return P(*spec)
+        return None
+
+    def state_spec(self, name: str, var) -> P:
+        spec = self._param_spec(name, var)
+        if spec is not None:
+            return spec
+        # optimizer accumulators (e.g. "<param>_velocity_0") inherit the
+        # parameter's sharding so optimizer math stays local to the shard
+        block = var.block if var is not None else None
+        if block is not None:
+            shape = var.shape
+            for pname, pvar in block.vars.items():
+                if pname != name and name.startswith(pname) and (
+                        getattr(pvar, "dist_spec", None) is not None
+                        and tuple(pvar.shape or ()) == tuple(shape or ())):
+                    return _spec_from_dist(pvar.dist_spec)
+        return P()
+
+    def feed_spec(self, name: str, var) -> P:
+        if name in self.feed_specs:
+            return self.feed_specs[name]
+        if var is not None and var.lod_level > 0:
+            return P()
+        dims = []
+        if self.dp_axis is not None:
+            dims.append(self.dp_axis)
+        ndim = var.ndim if var is not None and var.shape is not None else None
+        if self.sp_axis is not None and ndim is not None and ndim >= 2 and (
+                self.shard_all_seq or name in self.seq_feeds):
+            dims.append(self.sp_axis)
+        return P(*dims)
+
+
+class TensorParallelStrategy(HybridParallelStrategy):
+    """Pure TP (optionally + dp): params shard via dist_spec over
+    ``axis``; activations follow by propagation."""
+
+    def __init__(self, mesh: Mesh, axis: str = "tp",
+                 dp_axis: Optional[str] = None, **kw):
+        super().__init__(mesh, dp_axis=dp_axis, tp_axis=axis, **kw)

@@ -12,6 +12,7 @@ class ParamAttr:
         regularizer=None,
         trainable: bool = True,
         gradient_clip=None,
+        shard=None,
     ):
         self.name = name
         self.initializer = initializer
@@ -19,6 +20,9 @@ class ParamAttr:
         self.regularizer = regularizer
         self.trainable = trainable
         self.gradient_clip = gradient_clip
+        # sharding hint: PartitionSpec-shaped tuple, one entry per dim
+        # (mesh axis name or None), consumed by parallel strategies
+        self.shard = tuple(shard) if shard is not None else None
 
     @staticmethod
     def to_attr(arg) -> "ParamAttr":

@@ -73,9 +73,9 @@ def test_op_lowering_uses_pallas_and_trains(rng):
         (l2,) = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
         return float(l1), float(l2)
 
+    rng.seed(42)
     pk.enable(False)
     base = build_and_run()
-    rng2 = np.random.RandomState(42)
     try:
         pk.enable(True, interpret=True)
         rng.seed(42)
