@@ -30,6 +30,41 @@ def _is_parameter(var: Variable) -> bool:
     return isinstance(var, Parameter)
 
 
+_TENSOR_MAGIC = b"PTPU"
+_TENSOR_VERSION = 0
+
+
+def serialize_tensor_bytes(arr) -> bytes:
+    """Single-tensor file format (reference analog: the version-headered
+    format of operators/save_op.cc / doc/design/model_format.md):
+    magic, uint32 version, dtype-name, dims, raw little-endian data."""
+    import struct
+
+    arr = np.ascontiguousarray(np.asarray(arr))
+    dt = arr.dtype.name.encode()
+    head = _TENSOR_MAGIC + struct.pack("<I", _TENSOR_VERSION)
+    head += struct.pack("<H", len(dt)) + dt
+    head += struct.pack("<I", arr.ndim) + struct.pack(
+        f"<{arr.ndim}q", *arr.shape)
+    return head + arr.tobytes()
+
+
+def deserialize_tensor_bytes(buf: bytes) -> np.ndarray:
+    import struct
+
+    if buf[:4] != _TENSOR_MAGIC:
+        raise ValueError("not a paddle_tpu tensor file")
+    off = 4
+    (version,) = struct.unpack_from("<I", buf, off); off += 4
+    if version != _TENSOR_VERSION:
+        raise ValueError(f"unsupported tensor format version {version}")
+    (dtlen,) = struct.unpack_from("<H", buf, off); off += 2
+    dtype = np.dtype(buf[off:off + dtlen].decode()); off += dtlen
+    (ndim,) = struct.unpack_from("<I", buf, off); off += 4
+    dims = struct.unpack_from(f"<{ndim}q", buf, off); off += 8 * ndim
+    return np.frombuffer(buf, dtype=dtype, offset=off).reshape(dims).copy()
+
+
 def save_vars(executor, dirname: str, main_program: Optional[Program] = None,
               predicate=_is_persistable, vars=None):
     main_program = main_program or framework.default_main_program()

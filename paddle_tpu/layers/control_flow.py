@@ -20,6 +20,13 @@ __all__ = [
     "array_read",
     "array_length",
     "create_array",
+    "lod_rank_table",
+    "max_sequence_len",
+    "lod_tensor_to_array",
+    "array_to_lod_tensor",
+    "shrink_memory",
+    "split_lod_tensor",
+    "merge_lod_tensor",
 ]
 
 
@@ -342,3 +349,73 @@ class _IfElsePhase:
     def __exit__(self, exc_type, exc, tb):
         self.owner._phase = None
         return False
+
+# --- LoD dynamic-RNN machinery (reference: fluid/layers/control_flow.py
+# lod_rank_table/lod_tensor_to_array/array_to_lod_tensor/shrink_memory) ---
+
+
+def lod_rank_table(x: Variable, level: int = 0, **kwargs):
+    helper = LayerHelper("lod_rank_table", **kwargs)
+    out = helper.block.create_var(name=helper.name, dtype="int32",
+                                  type=framework.VarType.LOD_TENSOR)
+    helper.append_op(type="lod_rank_table", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"level": level})
+    return out
+
+
+def max_sequence_len(rank_table: Variable, **kwargs):
+    helper = LayerHelper("max_seq_len", **kwargs)
+    out = helper.create_tmp_variable("int32", ())
+    helper.append_op(type="max_sequence_len",
+                     inputs={"RankTable": [rank_table]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def lod_tensor_to_array(x: Variable, table: Variable, **kwargs):
+    helper = LayerHelper("lod_tensor_to_array", **kwargs)
+    out = helper.block.create_var(name=helper.name, dtype=x.dtype,
+                                  type=framework.VarType.LOD_TENSOR_ARRAY)
+    helper.append_op(type="lod_tensor_to_array",
+                     inputs={"X": [x], "RankTable": [table]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def array_to_lod_tensor(x: Variable, table: Variable, **kwargs):
+    helper = LayerHelper("array_to_lod_tensor", **kwargs)
+    out = helper.create_tmp_variable(x.dtype)
+    helper.append_op(type="array_to_lod_tensor",
+                     inputs={"X": [x], "RankTable": [table]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def shrink_memory(x: Variable, i: Variable, table: Variable, **kwargs):
+    helper = LayerHelper("shrink_memory", **kwargs)
+    out = helper.create_tmp_variable(x.dtype, x.shape)
+    helper.append_op(type="shrink_rnn_memory",
+                     inputs={"X": [x], "I": [i], "RankTable": [table]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def split_lod_tensor(input: Variable, mask: Variable, **kwargs):
+    helper = LayerHelper("split_lod_tensor", **kwargs)
+    out_true = helper.create_tmp_variable(input.dtype, input.shape)
+    out_false = helper.create_tmp_variable(input.dtype, input.shape)
+    helper.append_op(type="split_lod_tensor",
+                     inputs={"X": [input], "Mask": [mask]},
+                     outputs={"OutTrue": [out_true], "OutFalse": [out_false]})
+    return out_true, out_false
+
+
+def merge_lod_tensor(in_true: Variable, in_false: Variable, x: Variable,
+                     mask: Variable, **kwargs):
+    helper = LayerHelper("merge_lod_tensor", **kwargs)
+    out = helper.create_tmp_variable(in_true.dtype, in_true.shape)
+    helper.append_op(type="merge_lod_tensor",
+                     inputs={"X": [x], "Mask": [mask], "InTrue": [in_true],
+                             "InFalse": [in_false]},
+                     outputs={"Out": [out]})
+    return out

@@ -30,6 +30,8 @@ __all__ = [
     "layer_norm",
     "scaled_dot_product_attention",
     "multi_head_attention",
+    "lstm_unit",
+    "gru_unit",
 ]
 
 
@@ -478,3 +480,48 @@ def lstm(input, size: int, h0=None, c0=None, param_attr=None, bias_attr=None,
 
 
 dynamic_lstm = lstm
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias: float = 0.0,
+              param_attr=None, bias_attr=None, **kwargs):
+    """One LSTM step (reference: fluid/layers/nn.py lstm_unit →
+    operators/lstm_unit_op.cc): fc([x, h]) -> 4 gates -> (h, c)."""
+    from paddle_tpu.layers.tensor import concat
+
+    helper = LayerHelper("lstm_unit", param_attr=param_attr,
+                         bias_attr=bias_attr, **kwargs)
+    size = cell_t_prev.shape[-1]
+    concat_in = concat([x_t, hidden_t_prev], axis=-1)
+    gates = fc(concat_in, size * 4, param_attr=param_attr,
+               bias_attr=bias_attr)
+    c = helper.create_tmp_variable(x_t.dtype, cell_t_prev.shape)
+    h = helper.create_tmp_variable(x_t.dtype, cell_t_prev.shape)
+    helper.append_op(type="lstm_unit",
+                     inputs={"X": [gates], "C_prev": [cell_t_prev]},
+                     outputs={"C": [c], "H": [h]},
+                     attrs={"forget_bias": float(forget_bias)})
+    return h, c
+
+
+def gru_unit(input, hidden, size: int, param_attr=None, bias_attr=None,
+             activation: str = "tanh", **kwargs):
+    """One GRU step (reference: fluid/layers/nn.py gru_unit →
+    operators/gru_unit_op.cc).  ``size`` is 3 * hidden_dim; ``input``
+    must already be (B, size) (the x-projection)."""
+    helper = LayerHelper("gru_unit", param_attr=param_attr,
+                         bias_attr=bias_attr, **kwargs)
+    d = size // 3
+    w = helper.create_parameter(param_attr, shape=[d, size],
+                                dtype=input.dtype)
+    b = helper.create_parameter(bias_attr, shape=[size], dtype=input.dtype,
+                                default_initializer=ConstantInitializer(0.0))
+    gate = helper.create_tmp_variable(input.dtype, (input.shape[0], size))
+    rhp = helper.create_tmp_variable(input.dtype, (input.shape[0], d))
+    out = helper.create_tmp_variable(input.dtype, (input.shape[0], d))
+    helper.append_op(type="gru_unit",
+                     inputs={"Input": [input], "HiddenPrev": [hidden],
+                             "Weight": [w], "Bias": [b]},
+                     outputs={"Gate": [gate], "ResetHiddenPrev": [rhp],
+                              "Hidden": [out]},
+                     attrs={"activation": activation})
+    return out, rhp, gate

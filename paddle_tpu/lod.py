@@ -93,6 +93,39 @@ def row_segment_ids(offsets, num_rows: int):
     return jnp.searchsorted(offsets[1:], rows, side="right").astype(jnp.int32)
 
 
+@jax.tree_util.register_pytree_node_class
+class LoDRankTable:
+    """Sequences sorted by length, descending (reference:
+    framework/lod_rank_table.h, operators/lod_rank_table_op.cc).
+
+    ``index[k]`` = original sequence index of rank-k (longest-first)
+    sequence, ``lengths[k]`` its length.  ``offsets`` keeps the source
+    LoD level so array_to_lod_tensor can rebuild the packed layout, and
+    ``src_rows`` the static packed-row count of the source tensor (so
+    the rebuild returns the original buffer size, not max_len * n_seq).
+    Traced fields live inside jitted dynamic-RNN programs; src_rows is
+    static aux."""
+
+    def __init__(self, index, lengths, offsets, src_rows=None):
+        self.index = index
+        self.lengths = lengths
+        self.offsets = offsets
+        self.src_rows = src_rows
+
+    def tree_flatten(self):
+        return (self.index, self.lengths, self.offsets), self.src_rows
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, src_rows=aux)
+
+    def num_sequences(self):
+        return self.index.shape[0]
+
+    def __repr__(self):
+        return f"LoDRankTable(n={self.index.shape[0]})"
+
+
 def unwrap(x):
     return x.data if isinstance(x, LoDArray) else x
 

@@ -15,5 +15,9 @@ from paddle_tpu.ops import metric_ops  # noqa: F401
 from paddle_tpu.ops import sequence_ops  # noqa: F401
 from paddle_tpu.ops import control_flow_ops  # noqa: F401
 from paddle_tpu.ops import collective_ops  # noqa: F401
+from paddle_tpu.ops import lod_ops  # noqa: F401
+from paddle_tpu.ops import rnn_unit_ops  # noqa: F401
+from paddle_tpu.ops import beam_ops  # noqa: F401
+from paddle_tpu.ops import io_ops  # noqa: F401
 from paddle_tpu.ops import attention_ops  # noqa: F401
 from paddle_tpu.ops import pipeline_ops  # noqa: F401

@@ -1,0 +1,67 @@
+"""Beam-search ops.
+
+Reference: operators/beam_search_op.cc (one expansion/pruning step over
+a LoD candidate structure) and operators/beam_search_decode_op.cc
+(backtrack the step-wise selections into full sentences).
+
+TPU design: the reference keeps a ragged LoD beam state and prunes rows
+per step.  Here the beam state is dense (batch, beam) and a step is
+``top_k`` over the (batch, beam*vocab) score matrix — fixed shapes, one
+fused XLA kernel, no host round trips.  Finished beams are kept live
+and extended with end_id at zero cost, which matches the reference's
+"pruned" beams contributing nothing further.  The whole decode loop
+(see paddle_tpu.decoding.beam_search) is a lax.scan; these ops expose
+the step/decode pieces for program-IR parity.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.lod import unwrap
+from paddle_tpu.registry import register_op
+
+NEG_INF = -1.0e9
+
+
+@register_op("beam_search", inputs=("pre_ids", "pre_scores", "scores"),
+             outputs=("selected_ids", "selected_scores", "parent_idx"),
+             stop_gradient=True)
+def _beam_search(ctx):
+    pre_ids = unwrap(ctx.input("pre_ids")).astype(jnp.int32)     # (B, K)
+    pre_scores = unwrap(ctx.input("pre_scores"))                 # (B, K)
+    scores = unwrap(ctx.input("scores"))                         # (B, K, V)
+    end_id = int(ctx.attr("end_id", 0))
+    beam_size = int(ctx.attr("beam_size", pre_ids.shape[1]))
+    B, K, V = scores.shape
+    logp = jax.nn.log_softmax(scores.astype(jnp.float32), axis=-1)
+    finished = pre_ids == end_id
+    eos_only = jnp.full((B, K, V), NEG_INF).at[:, :, end_id].set(0.0)
+    logp = jnp.where(finished[..., None], eos_only, logp)
+    total = pre_scores[..., None] + logp                         # (B, K, V)
+    top_scores, top_idx = lax.top_k(total.reshape(B, K * V), beam_size)
+    ctx.set_output("selected_ids", (top_idx % V).astype(jnp.int64))
+    ctx.set_output("selected_scores", top_scores)
+    ctx.set_output("parent_idx", (top_idx // V).astype(jnp.int64))
+
+
+@register_op("beam_search_decode", inputs=("Ids", "ParentIdx", "Scores"),
+             outputs=("SentenceIds", "SentenceScores"), stop_gradient=True)
+def _beam_search_decode(ctx):
+    ids = unwrap(ctx.input("Ids")).astype(jnp.int32)             # (T, B, K)
+    parents = unwrap(ctx.input("ParentIdx")).astype(jnp.int32)   # (T, B, K)
+    scores = unwrap(ctx.input("Scores"))                         # (T, B, K)
+    T, B, K = ids.shape
+
+    def backtrack(ptr, tb):
+        tok_t, bp_t = tb
+        tok = jnp.take_along_axis(tok_t, ptr, axis=1)
+        return jnp.take_along_axis(bp_t, ptr, axis=1), tok
+
+    init_ptr = jnp.tile(jnp.arange(K, dtype=jnp.int32), (B, 1))
+    _, seq_rev = lax.scan(backtrack, init_ptr, (ids, parents), reverse=True)
+    ctx.set_output("SentenceIds", jnp.moveaxis(seq_rev, 0, 2).astype(jnp.int64))
+    ctx.set_output("SentenceScores",
+                   scores[-1] if T else jnp.zeros((B, K), scores.dtype))

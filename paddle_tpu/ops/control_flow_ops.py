@@ -166,9 +166,11 @@ def _lod_array_length(ctx):
 @register_op("max_sequence_len", inputs=("RankTable",), stop_gradient=True)
 def _max_sequence_len(ctx):
     x = ctx.input("RankTable")
-    from paddle_tpu.lod import LoDArray
+    from paddle_tpu.lod import LoDArray, LoDRankTable
 
-    if isinstance(x, LoDArray):
+    if isinstance(x, LoDRankTable):
+        ctx.set_output("Out", jnp.max(x.lengths).reshape(()))
+    elif isinstance(x, LoDArray):
         ctx.set_output("Out", jnp.max(x.seq_lens()).reshape(()))
     else:
         ctx.set_output("Out", jnp.asarray(unwrap(x).shape[1], jnp.int32))

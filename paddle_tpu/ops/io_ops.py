@@ -1,0 +1,100 @@
+"""IO and misc structural ops: feed/fetch, save/load, fill, cond.
+
+Reference: operators/feed_op.cc, fetch_op.cc, save_op.cc, load_op.cc
+(tensor serialization with a version header), fill_op.cc, cond_op.cc.
+
+TPU design: feed/fetch are pure plumbing — the executor binds feeds and
+fetches around the compiled block, so in-graph they lower to identity.
+``save`` uses an ordered io_callback (the XLA-sanctioned side-effect
+escape hatch) writing the same single-tensor file format io.py uses;
+``load`` reads at trace time and embeds the value as a device constant,
+which is exactly the semantics of running a load op once before the
+step loop.  The legacy ``cond`` op (scatter subset rows to two
+sub-nets, run, merge) becomes: run both sub-blocks dense over the full
+batch, then a row-wise where — branch-divergence-free, the way SIMD
+hardware wants it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import io_callback
+
+from paddle_tpu.lod import unwrap
+from paddle_tpu.registry import register_op
+
+
+@register_op("feed", inputs=("X",), stop_gradient=True)
+def _feed(ctx):
+    ctx.set_output("Out", ctx.input("X"))
+
+
+@register_op("fetch", inputs=("X",), stop_gradient=True)
+def _fetch(ctx):
+    ctx.set_output("Out", ctx.input("X"))
+
+
+@register_op("fill", inputs=(), stop_gradient=True)
+def _fill(ctx):
+    shape = tuple(int(s) for s in ctx.attr("shape", []))
+    dtype = jnp.dtype(ctx.attr("dtype", "float32"))
+    raw = ctx.attr("data", None)
+    if raw is not None:
+        ctx.set_output("Out", jnp.asarray(raw, dtype).reshape(shape))
+    else:
+        ctx.set_output("Out", jnp.full(shape, ctx.attr("value", 0.0), dtype))
+
+
+@register_op("save", inputs=("X",), outputs=(), stop_gradient=True)
+def _save(ctx):
+    from paddle_tpu.io import serialize_tensor_bytes
+
+    path = ctx.attr("file_path")
+    overwrite = bool(ctx.attr("overwrite", True))
+
+    def host_write(arr):
+        import os
+
+        if not overwrite and os.path.exists(path):
+            raise IOError(f"save op: {path} exists and overwrite=False")
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(serialize_tensor_bytes(arr))
+
+    io_callback(host_write, None, unwrap(ctx.input("X")), ordered=True)
+
+
+@register_op("load", inputs=(), stop_gradient=True)
+def _load(ctx):
+    from paddle_tpu.io import deserialize_tensor_bytes
+
+    path = ctx.attr("file_path")
+    with open(path, "rb") as f:
+        arr = deserialize_tensor_bytes(f.read())
+    ctx.set_output("Out", jnp.asarray(arr))
+
+
+@register_op("cond", inputs=("Cond", "Xs"), outputs=("Outs", "IndexTensors"))
+def _cond(ctx):
+    """Legacy two-branch cond (reference: operators/cond_op.cc): rows
+    where Cond is true flow through the true sub-block, the rest through
+    the false sub-block; outputs merge row-wise."""
+    from paddle_tpu.ops.control_flow_ops import _run_sub_block
+
+    mask = unwrap(ctx.input("Cond")).astype(bool).reshape(-1)
+    true_block = ctx.attr("true_block")
+    false_block = ctx.attr("false_block")
+    out_names = [n for n in ctx.op.output("Outs") if n]
+    outer = ctx.values
+
+    def run(block):
+        values = dict(outer)
+        _run_sub_block(block, values, ctx.executor_ctx)
+        return [values[n] for n in out_names]
+
+    t_outs, f_outs = run(true_block), run(false_block)
+    for n, t, f in zip(out_names, t_outs, f_outs):
+        t, f = unwrap(t), unwrap(f)
+        m = mask.reshape((-1,) + (1,) * (t.ndim - 1))
+        outer[n] = jnp.where(m, t, f)

@@ -1,0 +1,134 @@
+"""LoD structure ops: rank table, tensor<->array, RNN memory plumbing.
+
+Reference: operators/lod_rank_table_op.cc, lod_tensor_to_array_op.cc,
+array_to_lod_tensor_op.cc, shrink_rnn_memory_op.cc,
+rnn_memory_helper_op.cc, split_lod_tensor_op.cc, merge_lod_tensor_op.cc
+— the machinery behind fluid's length-sorted dynamic RNN
+(python/paddle/v2/fluid/layers/control_flow.py).
+
+TPU design: the reference physically regroups ragged rows into
+per-timestep tensors of *shrinking* batch size.  Under a static-shape
+compiler we keep a fixed (max_len, n_seq, D) batch-major buffer ordered
+by the rank table (longest sequence first) and *mask* instead of
+shrinking: ``shrink_rnn_memory`` zero-masks retired rows rather than
+slicing them off, which preserves the observable semantics (retired
+sequences stop contributing) while every step stays one fixed-shape MXU
+matmul.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.lod import LoDArray, LoDRankTable, row_segment_ids, unwrap
+from paddle_tpu.registry import register_op
+from paddle_tpu.tensor_array import TensorArray
+
+
+@register_op("lod_rank_table", inputs=("X",), stop_gradient=True)
+def _lod_rank_table(ctx):
+    x = ctx.input("X")
+    assert isinstance(x, LoDArray), "lod_rank_table needs a LoD input"
+    level = int(ctx.attr("level", 0))
+    off = x.lod[level]
+    lens = off[1:] - off[:-1]
+    # stable descending sort by length (reference keeps input order for ties)
+    order = jnp.argsort(-lens, stable=True).astype(jnp.int32)
+    ctx.set_output("Out", LoDRankTable(order, lens[order], x.last_level(),
+                                       src_rows=x.data.shape[0]))
+
+
+def _batch_major(x: LoDArray, table: LoDRankTable):
+    """Packed rows -> (max_len, n_seq, D) ordered by rank table."""
+    data = x.data
+    off = x.last_level()
+    nseq = off.shape[0] - 1
+    max_len = data.shape[0]  # static upper bound on any sequence length
+    ids = row_segment_ids(off, data.shape[0])          # seq id per row
+    pos = jnp.arange(data.shape[0], dtype=jnp.int32) - jnp.take(
+        off, jnp.minimum(ids, nseq - 1))               # step within sequence
+    # rank of each sequence: inverse permutation of table.index
+    rank_of = jnp.zeros(nseq, jnp.int32).at[table.index].set(
+        jnp.arange(nseq, dtype=jnp.int32))
+    col = jnp.take(rank_of, jnp.minimum(ids, nseq - 1))
+    valid = ids < nseq
+    flat_idx = jnp.where(valid, pos * nseq + col, max_len * nseq)
+    buf = jnp.zeros((max_len * nseq + 1,) + data.shape[1:], data.dtype)
+    buf = buf.at[flat_idx].set(data)
+    return buf[:-1].reshape((max_len, nseq) + data.shape[1:])
+
+
+@register_op("lod_tensor_to_array", inputs=("X", "RankTable"))
+def _lod_tensor_to_array(ctx):
+    x = ctx.input("X")
+    table = ctx.input("RankTable")
+    assert isinstance(x, LoDArray) and isinstance(table, LoDRankTable)
+    bm = _batch_major(x, table)
+    ctx.set_output("Out", TensorArray(bm, jnp.max(table.lengths).astype(jnp.int32)))
+
+
+@register_op("array_to_lod_tensor", inputs=("X", "RankTable"))
+def _array_to_lod_tensor(ctx):
+    ta = ctx.input("X")
+    table = ctx.input("RankTable")
+    bm = ta.stack                                     # (max_len, n_seq, D)
+    max_len, nseq = bm.shape[0], bm.shape[1]
+    off = table.offsets
+    total = bm.shape[0] * nseq
+    ids = row_segment_ids(off, total)                 # dest seq per packed row
+    pos = jnp.arange(total, dtype=jnp.int32) - jnp.take(
+        off, jnp.minimum(ids, nseq - 1))
+    rank_of = jnp.zeros(nseq, jnp.int32).at[table.index].set(
+        jnp.arange(nseq, dtype=jnp.int32))
+    col = jnp.take(rank_of, jnp.minimum(ids, nseq - 1))
+    valid = ids < nseq
+    src = jnp.where(valid, pos * nseq + col, 0)
+    flat = bm.reshape((total,) + bm.shape[2:])
+    rows = jnp.take(flat, jnp.minimum(src, total - 1), axis=0)
+    rows = jnp.where(
+        valid.reshape((-1,) + (1,) * (rows.ndim - 1)), rows, 0)
+    # restore the source packed buffer size (rows beyond off[-1] are the
+    # zero padding the original tensor carried)
+    n_rows = ctx.attr("max_rows", table.src_rows) or total
+    ctx.set_output("Out", LoDArray(rows[:n_rows], (off,)))
+
+
+@register_op("shrink_rnn_memory", inputs=("X", "RankTable", "I"))
+def _shrink_rnn_memory(ctx):
+    """Zero-mask memory rows of sequences that ended before step I
+    (reference slices the first k rows off; see module docstring)."""
+    x = unwrap(ctx.input("X"))                        # (n_seq, D) rank-ordered
+    table = ctx.input("RankTable")
+    i = jnp.reshape(unwrap(ctx.input("I")), ()).astype(jnp.int32)
+    alive = (table.lengths > i).astype(x.dtype)       # rank-ordered, desc
+    ctx.set_output("Out", x * alive.reshape((-1,) + (1,) * (x.ndim - 1)))
+
+
+@register_op("rnn_memory_helper", inputs=("X",))
+def _rnn_memory_helper(ctx):
+    # identity plumbing var for memory hand-off between steps
+    ctx.set_output("Out", ctx.input("X"))
+
+
+@register_op("split_lod_tensor", inputs=("X", "Mask"),
+             outputs=("OutTrue", "OutFalse"), diff_inputs=("X",))
+def _split_lod_tensor(ctx):
+    """Mask-split rows (reference physically partitions; we zero-mask the
+    complementary rows so both outputs keep the static shape)."""
+    x = unwrap(ctx.input("X"))
+    mask = unwrap(ctx.input("Mask")).astype(bool).reshape(-1)
+    m = mask.reshape((-1,) + (1,) * (x.ndim - 1))
+    ctx.set_output("OutTrue", jnp.where(m, x, 0))
+    ctx.set_output("OutFalse", jnp.where(m, 0, x))
+
+
+@register_op("merge_lod_tensor", inputs=("X", "Mask", "InTrue", "InFalse"),
+             diff_inputs=("InTrue", "InFalse"))
+def _merge_lod_tensor(ctx):
+    t = unwrap(ctx.input("InTrue"))
+    f = unwrap(ctx.input("InFalse"))
+    mask = unwrap(ctx.input("Mask")).astype(bool).reshape(-1)
+    m = mask.reshape((-1,) + (1,) * (t.ndim - 1))
+    ctx.set_output("Out", jnp.where(m, t, f))

@@ -58,3 +58,161 @@ def _auc(ctx):
     fpr = fp / n_total
     auc = -jnp.trapezoid(tpr, fpr)
     ctx.set_output("AUC", auc.reshape(1))
+
+
+@register_op("chunk_eval", inputs=("Inference", "Label"),
+             outputs=("Precision", "Recall", "F1-Score", "NumInferChunks",
+                      "NumLabelChunks", "NumCorrectChunks"),
+             stop_gradient=True)
+def _chunk_eval(ctx):
+    """Chunk-level precision/recall/F1 for sequence tagging (reference:
+    operators/chunk_eval_op.cc; schemes IOB/IOE/IOBES/plain).
+
+    Jittable reformulation: a predicted chunk [s, e] of type t counts as
+    correct iff the label tags are identical over [s, e] and the label
+    sequence starts a chunk at s and ends one at e — no host-side span
+    lists, just boundary masks + segment mins."""
+    import jax
+
+    inf = unwrap(ctx.input("Inference")).astype(jnp.int32).reshape(-1)
+    lab = unwrap(ctx.input("Label")).astype(jnp.int32).reshape(-1)
+    scheme = ctx.attr("chunk_scheme", "IOB")
+    num_types = int(ctx.attr("num_chunk_types", 1))
+    x = ctx.input("Inference")
+    from paddle_tpu.lod import LoDArray, row_segment_ids
+
+    n = inf.shape[0]
+    if isinstance(x, LoDArray):
+        seq_id = row_segment_ids(x.last_level(), n)
+        nseq = x.num_sequences()
+    else:
+        seq_id = jnp.zeros(n, jnp.int32)
+        nseq = 1
+
+    n_tag = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}[scheme]
+    # outside tag = num_types * n_tag (the reference's "other")
+    outside = num_types * n_tag
+
+    def masks(tags):
+        inside = tags < outside
+        ttype = jnp.where(inside, tags // n_tag, -1)
+        tpos = jnp.where(inside, tags % n_tag, -1)
+        prev = jnp.concatenate([jnp.full((1,), -2, jnp.int32), tags[:-1]])
+        prev_type = jnp.where(prev >= 0, prev // n_tag, -1)
+        prev_in = (prev >= 0) & (prev < outside)
+        first = jnp.concatenate(
+            [jnp.ones((1,), bool),
+             seq_id[1:] != seq_id[:-1]]) if n > 1 else jnp.ones((1,), bool)
+        nxt = jnp.concatenate([tags[1:], jnp.full((1,), -2, jnp.int32)])
+        nxt_type = jnp.where(nxt >= 0, nxt // n_tag, -1)
+        nxt_in = (nxt >= 0) & (nxt < outside)
+        last = jnp.concatenate(
+            [seq_id[1:] != seq_id[:-1],
+             jnp.ones((1,), bool)]) if n > 1 else jnp.ones((1,), bool)
+        if scheme == "IOB":        # tag 0 = B, 1 = I
+            start = inside & ((tpos == 0) | first | ~prev_in
+                              | (prev_type != ttype))
+            end = inside & (last | ~nxt_in | (nxt_type != ttype)
+                            | (nxt % n_tag == 0))
+        elif scheme == "IOE":      # tag 0 = I, 1 = E
+            start = inside & (first | ~prev_in | (prev_type != ttype)
+                              | (prev % n_tag == 1))
+            end = inside & ((tpos == 1) | last | ~nxt_in
+                            | (nxt_type != ttype))
+        elif scheme == "IOBES":    # 0=B 1=I 2=E 3=S
+            start = inside & ((tpos == 0) | (tpos == 3))
+            end = inside & ((tpos == 2) | (tpos == 3))
+        else:                      # plain: every maximal same-type run
+            start = inside & (first | (prev != tags))
+            end = inside & (last | (nxt != tags))
+        return inside, ttype, start, end
+
+    inf_inside, inf_type, inf_start, inf_end = masks(inf)
+    _, lab_type, lab_start, lab_end = masks(lab)
+
+    num_inf = jnp.sum(inf_start)
+    num_lab = jnp.sum(lab_start)
+
+    # chunk id per position from inference starts; positions before the
+    # first start get id 0 but are excluded via the inside mask at starts
+    chunk_id = jnp.cumsum(inf_start.astype(jnp.int32)) - 1
+    eq = (inf == lab)
+    # min over each inference chunk of tag equality; only positions that
+    # actually lie inside an inference chunk participate (trailing
+    # outside tags carry the previous chunk's id, and malformed leading
+    # inside tags have chunk_id -1 — both must not poison the min)
+    in_chunk = inf_inside & (chunk_id >= 0)
+    n_chunks_cap = n
+    all_eq = jax.ops.segment_min(
+        jnp.where(in_chunk, eq, True).astype(jnp.int32),
+        jnp.maximum(chunk_id, 0), num_segments=n_chunks_cap)
+    # a chunk is correct if: starts aligned + types equal + tags equal
+    # throughout + ends aligned (end position of inference chunk also
+    # ends a label chunk)
+    end_ok = jax.ops.segment_min(
+        jnp.where(inf_end & in_chunk, lab_end, True).astype(jnp.int32),
+        jnp.maximum(chunk_id, 0), num_segments=n_chunks_cap)
+    per_start = (inf_start & lab_start & (inf_type == lab_type))
+    chunk_ok = jnp.take(all_eq * end_ok, jnp.maximum(chunk_id, 0))
+    num_correct = jnp.sum(per_start & (chunk_ok > 0))
+
+    p = num_correct / jnp.maximum(num_inf, 1)
+    r = num_correct / jnp.maximum(num_lab, 1)
+    f1 = 2 * p * r / jnp.maximum(p + r, 1e-12)
+    ctx.set_output("Precision", p.astype(jnp.float32).reshape(1))
+    ctx.set_output("Recall", r.astype(jnp.float32).reshape(1))
+    ctx.set_output("F1-Score", f1.astype(jnp.float32).reshape(1))
+    ctx.set_output("NumInferChunks", num_inf.astype(jnp.int64).reshape(1))
+    ctx.set_output("NumLabelChunks", num_lab.astype(jnp.int64).reshape(1))
+    ctx.set_output("NumCorrectChunks", num_correct.astype(jnp.int64).reshape(1))
+
+
+@register_op("positive_negative_pair", inputs=("Score", "Label", "QueryID"),
+             outputs=("PositivePair", "NegativePair", "NeutralPair"),
+             stop_gradient=True)
+def _positive_negative_pair(ctx):
+    """Query-grouped ranking pair stats (reference:
+    operators/positive_negative_pair_op.cc): over pairs (i, j) in the
+    same query with different labels — positive if the score order
+    matches the label order, neutral on score ties."""
+    score = unwrap(ctx.input("Score")).reshape(-1)
+    label = unwrap(ctx.input("Label")).reshape(-1).astype(score.dtype)
+    qid = unwrap(ctx.input("QueryID")).reshape(-1)
+    n = score.shape[0]
+
+    def counts_for_rows(s_blk, l_blk, q_blk, row0, blk):
+        # (blk, n) pairwise slab — peak memory O(blk * n), not O(n^2)
+        rows = row0 + jnp.arange(blk)
+        upper = rows[:, None] < jnp.arange(n)[None, :]
+        valid = (q_blk[:, None] == qid[None, :]) & upper & (
+            l_blk[:, None] != label[None, :])
+        s_cmp = jnp.sign(s_blk[:, None] - score[None, :])
+        l_cmp = jnp.sign(l_blk[:, None] - label[None, :])
+        pos = jnp.sum(valid & (s_cmp == l_cmp) & (s_cmp != 0))
+        neu = jnp.sum(valid & (s_cmp == 0))
+        return pos, neu, jnp.sum(valid)
+
+    blk = min(n, 1024)
+    n_blocks = -(-n // blk)
+    if n_blocks == 1:
+        pos, neu, tot = counts_for_rows(score, label, qid, 0, n)
+    else:
+        pad = n_blocks * blk - n
+        # pad with qid = -1 rows: they match no real query, count nothing
+        sp = jnp.pad(score, (0, pad))
+        lp = jnp.pad(label, (0, pad))
+        qp = jnp.pad(qid, (0, pad), constant_values=-1)
+
+        def body(i, acc):
+            s_blk = lax.dynamic_slice_in_dim(sp, i * blk, blk)
+            l_blk = lax.dynamic_slice_in_dim(lp, i * blk, blk)
+            q_blk = lax.dynamic_slice_in_dim(qp, i * blk, blk)
+            p, u, t = counts_for_rows(s_blk, l_blk, q_blk, i * blk, blk)
+            return acc[0] + p, acc[1] + u, acc[2] + t
+
+        zero = jnp.asarray(0, jnp.int32)
+        pos, neu, tot = lax.fori_loop(0, n_blocks, body, (zero, zero, zero))
+    neg = tot - pos - neu
+    ctx.set_output("PositivePair", pos.astype(jnp.float32).reshape(1))
+    ctx.set_output("NegativePair", neg.astype(jnp.float32).reshape(1))
+    ctx.set_output("NeutralPair", neu.astype(jnp.float32).reshape(1))

@@ -1,0 +1,66 @@
+"""Single-step recurrent cell ops.
+
+Reference: operators/lstm_unit_op.cc (inputs X = packed gates (B, 4D)
+and C_prev; outputs C, H) and operators/gru_unit_op.cc (inputs Input
+(B, 3D), HiddenPrev, Weight (D, 3D), Bias; outputs Gate,
+ResetHiddenPrev, Hidden).
+
+These are the building blocks fluid's StaticRNN uses; the fused
+whole-sequence ``lstm``/``gru`` ops (control_flow_ops / sequence path)
+are the fast path — these unit ops exist for per-step graphs and
+parity.  Gate math runs in one fused elementwise region after the
+caller's big matmul, exactly what XLA fuses onto the MXU output.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.lod import unwrap
+from paddle_tpu.registry import register_op
+
+
+@register_op("lstm_unit", inputs=("X", "C_prev"), outputs=("C", "H"))
+def _lstm_unit(ctx):
+    x = unwrap(ctx.input("X"))                # (B, 4D): i, g (cell cand), f, o
+    c_prev = unwrap(ctx.input("C_prev"))      # (B, D)
+    forget_bias = float(ctx.attr("forget_bias", 0.0))
+    d = c_prev.shape[-1]
+    i, g, f, o = (x[..., 0:d], x[..., d:2 * d], x[..., 2 * d:3 * d],
+                  x[..., 3 * d:4 * d])
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f + forget_bias)
+    o = jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c = f * c_prev + i * g
+    h = o * jnp.tanh(c)
+    ctx.set_output("C", c)
+    ctx.set_output("H", h)
+
+
+@register_op("gru_unit", inputs=("Input", "HiddenPrev", "Weight", "Bias"),
+             outputs=("Gate", "ResetHiddenPrev", "Hidden"))
+def _gru_unit(ctx):
+    """u = sigma(xu + h W_u); r = sigma(xr + h W_r);
+    c = act(xc + (r*h) W_c); h' = u*h + (1-u)*c  (reference gate order
+    update/reset/candidate, gru_unit_op.cc)."""
+    x = unwrap(ctx.input("Input"))            # (B, 3D)
+    h_prev = unwrap(ctx.input("HiddenPrev"))  # (B, D)
+    w = unwrap(ctx.input("Weight"))           # (D, 3D)
+    b = unwrap(ctx.input("Bias")) if ctx.has_input("Bias") else None
+    d = h_prev.shape[-1]
+    if b is not None:
+        x = x + b.reshape((1, 3 * d))
+    w_rz, w_c = w[:, : 2 * d], w[:, 2 * d:]
+    gates = x[..., : 2 * d] + h_prev @ w_rz
+    u = jax.nn.sigmoid(gates[..., :d])
+    r = jax.nn.sigmoid(gates[..., d: 2 * d])
+    act = {"tanh": jnp.tanh, "relu": jax.nn.relu,
+           "sigmoid": jax.nn.sigmoid, "identity": lambda v: v}[
+        ctx.attr("activation", "tanh")]
+    c = act(x[..., 2 * d:] + (r * h_prev) @ w_c)
+    h = u * h_prev + (1.0 - u) * c
+    ctx.set_output("Gate", jnp.concatenate([u, r, c], axis=-1))
+    ctx.set_output("ResetHiddenPrev", r * h_prev)
+    ctx.set_output("Hidden", h)

@@ -47,7 +47,7 @@ def gpipe(layer_fn: Callable, stacked_params, x, *, mesh, pp_axis: str,
     stacked_params: pytree of (L, ...) arrays, L = total layers.
     x: (B, S, ...) global activations; microbatched on dim 0.
 
-    Batch must divide n_microbatch; L must divide the pp axis size.
+    n_microbatch must divide the batch; the pp axis size must divide L.
     """
     B = x.shape[0]
     assert B % n_microbatch == 0, (B, n_microbatch)

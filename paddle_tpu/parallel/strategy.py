@@ -186,14 +186,15 @@ class HybridParallelStrategy(Strategy):
             return self.feed_specs[name]
         if var is not None and var.lod_level > 0:
             return P()
-        dims = []
-        if self.dp_axis is not None:
-            dims.append(self.dp_axis)
+        # positional: dim 0 = batch (dp), dim 1 = sequence (sp); a None
+        # dp axis must still hold the batch slot so sp lands on dim 1
         ndim = var.ndim if var is not None and var.shape is not None else None
         if self.sp_axis is not None and ndim is not None and ndim >= 2 and (
                 self.shard_all_seq or name in self.seq_feeds):
-            dims.append(self.sp_axis)
-        return P(*dims)
+            return P(self.dp_axis, self.sp_axis)
+        if self.dp_axis is not None:
+            return P(self.dp_axis)
+        return P()
 
 
 class TensorParallelStrategy(HybridParallelStrategy):

@@ -1,0 +1,391 @@
+"""Tests for op wave 3: RNN unit ops, LoD rank-table family, beam
+search ops, chunk_eval, positive_negative_pair, save/load/fill."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import create_lod_array
+from tests.op_test import OpTest
+
+
+def _fetch_op(op_type, inputs, attrs, out_slots, feed):
+    """Build a one-op program with raw vars and fetch its outputs."""
+    import paddle_tpu.framework as framework
+
+    framework.reset_default_programs()
+    prog = fluid.default_main_program()
+    block = prog.global_block()
+    in_map = {}
+    for slot, entries in inputs.items():
+        names = []
+        for name, arr in entries:
+            from paddle_tpu.lod import LoDArray
+
+            lod_level = 1 if isinstance(arr, LoDArray) else 0
+            shape = arr.data.shape if isinstance(arr, LoDArray) else np.asarray(arr).shape
+            dtype = (str(arr.data.dtype) if isinstance(arr, LoDArray)
+                     else str(np.asarray(arr).dtype))
+            block.create_var(name=name, shape=shape, dtype=dtype,
+                             lod_level=lod_level)
+            names.append(name)
+        in_map[slot] = names
+    out_map = {}
+    for slot in out_slots:
+        name = f"{slot}_out"
+        block.create_var(name=name, dtype="float32")
+        out_map[slot] = [name]
+    block.append_op(type=op_type, inputs=in_map, outputs=out_map, attrs=attrs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    return exe.run(prog, feed=feed,
+                   fetch_list=[out_map[s][0] for s in out_slots])
+
+
+class TestLstmUnit(OpTest):
+    op_type = "lstm_unit"
+
+    def test_forward(self, rng):
+        B, D = 4, 8
+        x = rng.randn(B, 4 * D).astype("float32")
+        c_prev = rng.randn(B, D).astype("float32")
+        fb = 0.5
+
+        def sig(v):
+            return 1.0 / (1.0 + np.exp(-v))
+
+        i, g, f, o = x[:, :D], x[:, D:2 * D], x[:, 2 * D:3 * D], x[:, 3 * D:]
+        c = sig(f + fb) * c_prev + sig(i) * np.tanh(g)
+        h = sig(o) * np.tanh(c)
+        self.check_output(
+            {"X": [("x", x)], "C_prev": [("c", c_prev)]},
+            {"forget_bias": fb}, {"C": c, "H": h}, atol=1e-5)
+
+    def test_grad(self, rng):
+        B, D = 3, 4
+        x = rng.randn(B, 4 * D).astype("float32")
+        c_prev = rng.randn(B, D).astype("float32")
+        self.check_grad({"X": [("x", x)], "C_prev": [("c", c_prev)]},
+                        {"forget_bias": 0.0}, ["H"], ["x", "c"],
+                        loss_slot="H")
+
+
+class TestGruUnit(OpTest):
+    op_type = "gru_unit"
+
+    def test_forward(self, rng):
+        B, D = 4, 6
+        x = rng.randn(B, 3 * D).astype("float32")
+        h_prev = rng.randn(B, D).astype("float32")
+        w = (rng.randn(D, 3 * D) * 0.5).astype("float32")
+        b = np.zeros(3 * D, "float32")
+
+        def sig(v):
+            return 1.0 / (1.0 + np.exp(-v))
+
+        gates = x[:, :2 * D] + h_prev @ w[:, :2 * D]
+        u, r = sig(gates[:, :D]), sig(gates[:, D:])
+        c = np.tanh(x[:, 2 * D:] + (r * h_prev) @ w[:, 2 * D:])
+        h = u * h_prev + (1 - u) * c
+        self.check_output(
+            {"Input": [("x", x)], "HiddenPrev": [("h", h_prev)],
+             "Weight": [("w", w)], "Bias": [("b", b)]},
+            {}, {"Hidden": h}, atol=1e-5)
+
+
+def _rank_table_fixture():
+    # 3 sequences of lengths 2, 4, 1 packed into 7 rows + 1 pad row
+    data = np.arange(16, dtype=np.float32).reshape(8, 2)
+    return create_lod_array(data, [[0, 2, 6, 7]])
+
+
+def test_lod_rank_table_and_max_len():
+    import paddle_tpu.framework as framework
+
+    framework.reset_default_programs()
+    x = _rank_table_fixture()
+    prog = fluid.default_main_program()
+    v = fluid.layers.data(name="x", shape=[2], dtype="float32", lod_level=1)
+    table = fluid.layers.lod_rank_table(v)
+    mlen = fluid.layers.max_sequence_len(table)
+    exe = fluid.Executor(fluid.CPUPlace())
+    got = exe.run(prog, feed={"x": x}, fetch_list=[mlen])
+    assert int(np.asarray(got[0])) == 4
+
+
+def test_lod_tensor_to_array_layout():
+    import jax.numpy as jnp
+
+    from paddle_tpu.lod import LoDRankTable
+    from paddle_tpu.ops.lod_ops import _batch_major
+
+    x = _rank_table_fixture()
+    lens = np.array([2, 4, 1])
+    order = np.argsort(-lens, kind="stable").astype(np.int32)
+    table = LoDRankTable(jnp.asarray(order), jnp.asarray(lens[order]),
+                         x.last_level())
+    bm = np.asarray(_batch_major(x, table))
+    np.testing.assert_array_equal(bm[0, 0], x.data[2])  # longest seq step 0
+    np.testing.assert_array_equal(bm[0, 1], x.data[0])  # seq 0 step 0
+    np.testing.assert_array_equal(bm[0, 2], x.data[6])  # seq 2 step 0
+    np.testing.assert_array_equal(bm[3, 0], x.data[5])  # longest seq step 3
+    assert bm[1, 2].sum() == 0  # seq 2 len 1 -> later steps padded
+
+
+def test_shrink_rnn_memory_masks_ended():
+    import paddle_tpu.framework as framework
+
+    framework.reset_default_programs()
+    x = _rank_table_fixture()
+    prog = fluid.default_main_program()
+    v = fluid.layers.data(name="x", shape=[2], dtype="float32", lod_level=1)
+    mem = fluid.layers.data(name="m", shape=[2], dtype="float32")
+    step = fluid.layers.data(name="i", shape=[1], dtype="int32")
+    table = fluid.layers.lod_rank_table(v)
+    out = fluid.layers.shrink_memory(mem, step, table)
+    exe = fluid.Executor(fluid.CPUPlace())
+    got = exe.run(prog, feed={"x": x, "m": np.ones((3, 2), np.float32),
+                              "i": np.asarray([2], np.int32)},
+                  fetch_list=[out])
+    # rank order: lens desc [4, 2, 1]; at step 2 only the len-4 seq lives
+    np.testing.assert_array_equal(np.asarray(got[0]),
+                                  [[1, 1], [0, 0], [0, 0]])
+
+
+def test_beam_search_op_step():
+    B, K, V = 1, 2, 4
+    pre_ids = np.array([[3, 1]], np.int64)        # beam 0 finished (end=3)
+    pre_scores = np.array([[-0.5, -0.1]], np.float32)
+    scores = np.zeros((B, K, V), np.float32)
+    scores[0, 1] = [10.0, 0.0, 0.0, 0.0]          # beam 1 wants token 0
+    outs = _fetch_op(
+        "beam_search",
+        {"pre_ids": [("pi", pre_ids)], "pre_scores": [("ps", pre_scores)],
+         "scores": [("s", scores)]},
+        {"beam_size": K, "end_id": 3},
+        ["selected_ids", "selected_scores", "parent_idx"],
+        {"pi": pre_ids, "ps": pre_scores, "s": scores})
+    ids, sc, par = (np.asarray(o) for o in outs)
+    assert ids[0, 0] == 0 and par[0, 0] == 1
+    assert ids[0, 1] == 3 and par[0, 1] == 0
+    assert sc[0, 1] == pytest.approx(-0.5, abs=1e-5)
+
+
+def test_beam_search_decode_backtrack():
+    ids = np.array([[[7, 8]], [[5, 6]], [[1, 2]]], np.int64)
+    parents = np.array([[[0, 1]], [[1, 0]], [[0, 1]]], np.int64)
+    scores = np.random.RandomState(0).randn(3, 1, 2).astype("float32")
+    outs = _fetch_op(
+        "beam_search_decode",
+        {"Ids": [("i", ids)], "ParentIdx": [("p", parents)],
+         "Scores": [("s", scores)]},
+        {}, ["SentenceIds", "SentenceScores"],
+        {"i": ids, "p": parents, "s": scores})
+    seq = np.asarray(outs[0])
+    # parents[t][k] = beam at t-1 that beam k's token at t extends:
+    # t2 beam0 took token 1 (parent beam 0 at t1) -> token 5 (parent
+    # beam 1 at t0) -> token 8
+    np.testing.assert_array_equal(seq[0, 0], [8, 5, 1])
+
+
+def test_chunk_eval_iob():
+    lab = np.array([0, 1, 2, 0, 1], np.int64)   # chunks [0,1] and [3,4]
+    inf = np.array([0, 1, 2, 0, 2], np.int64)   # chunks [0,1] and [3,3]
+    outs = _fetch_op(
+        "chunk_eval",
+        {"Inference": [("i", inf)], "Label": [("l", lab)]},
+        {"chunk_scheme": "IOB", "num_chunk_types": 1},
+        ["Precision", "Recall", "F1-Score", "NumInferChunks",
+         "NumLabelChunks", "NumCorrectChunks"],
+        {"i": inf, "l": lab})
+    p, r, f1, ni, nl, nc = (np.asarray(o) for o in outs)
+    assert ni[0] == 2 and nl[0] == 2 and nc[0] == 1
+    assert p[0] == pytest.approx(0.5) and r[0] == pytest.approx(0.5)
+
+
+def test_chunk_eval_iobes_exact_match():
+    lab = np.array([0, 1, 2, 8, 3, 7], np.int64)
+    outs = _fetch_op(
+        "chunk_eval",
+        {"Inference": [("i", lab)], "Label": [("l", lab.copy())]},
+        {"chunk_scheme": "IOBES", "num_chunk_types": 2},
+        ["Precision", "Recall", "F1-Score", "NumInferChunks",
+         "NumLabelChunks", "NumCorrectChunks"],
+        {"i": lab, "l": lab.copy()})
+    p, r, f1, ni, nl, nc = (np.asarray(o) for o in outs)
+    assert ni[0] == nl[0] == nc[0] and nc[0] > 0
+    assert f1[0] == pytest.approx(1.0)
+
+
+def test_positive_negative_pair():
+    score = np.array([0.9, 0.2, 0.5, 0.4], np.float32)
+    label = np.array([1, 0, 1, 0], np.float32)
+    qid = np.array([0, 0, 1, 1], np.int32)
+    outs = _fetch_op(
+        "positive_negative_pair",
+        {"Score": [("s", score)], "Label": [("l", label)],
+         "QueryID": [("q", qid)]},
+        {}, ["PositivePair", "NegativePair", "NeutralPair"],
+        {"s": score, "l": label, "q": qid})
+    pos, neg, neu = (np.asarray(o)[0] for o in outs)
+    assert pos == 2 and neg == 0 and neu == 0
+
+
+def test_save_load_ops_roundtrip(tmp_path, rng):
+    import paddle_tpu.framework as framework
+
+    framework.reset_default_programs()
+    path = str(tmp_path / "w.pt")
+    x = rng.randn(3, 4).astype("float32")
+    v = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    prog = fluid.default_main_program()
+    block = prog.global_block()
+    block.append_op(type="save", inputs={"X": [v.name]}, outputs={},
+                    attrs={"file_path": path})
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(prog, feed={"x": x}, fetch_list=[])
+    assert os.path.exists(path)
+
+    framework.reset_default_programs()
+    prog = fluid.default_main_program()
+    block = prog.global_block()
+    block.create_var(name="loaded", shape=[3, 4], dtype="float32")
+    block.append_op(type="load", inputs={}, outputs={"Out": ["loaded"]},
+                    attrs={"file_path": path})
+    got = fluid.Executor(fluid.TPUPlace()).run(prog, fetch_list=["loaded"])[0]
+    np.testing.assert_allclose(np.asarray(got), x, atol=1e-6)
+
+
+def test_serialize_tensor_format():
+    from paddle_tpu.io import deserialize_tensor_bytes, serialize_tensor_bytes
+
+    for arr in (np.arange(6, dtype=np.float32).reshape(2, 3),
+                np.array(3.5, np.float64),
+                np.arange(4, dtype=np.int64)):
+        got = deserialize_tensor_bytes(serialize_tensor_bytes(arr))
+        assert got.dtype == arr.dtype
+        np.testing.assert_array_equal(got, arr)
+
+
+def test_fill_op():
+    outs = _fetch_op("fill", {}, {"shape": [2, 3], "value": 1.5,
+                                  "dtype": "float32"}, ["Out"], {})
+    np.testing.assert_allclose(np.asarray(outs[0]),
+                               np.full((2, 3), 1.5, np.float32))
+
+
+def test_lstm_unit_layer(rng):
+    import paddle_tpu.framework as framework
+
+    framework.reset_default_programs()
+    x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+    h0 = fluid.layers.data(name="h", shape=[4], dtype="float32")
+    c0 = fluid.layers.data(name="c", shape=[4], dtype="float32")
+    h, c = fluid.layers.lstm_unit(x, h0, c0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    got = exe.run(fluid.default_main_program(),
+                  feed={"x": rng.randn(2, 8).astype("float32"),
+                        "h": rng.randn(2, 4).astype("float32"),
+                        "c": rng.randn(2, 4).astype("float32")},
+                  fetch_list=[h, c])
+    assert np.asarray(got[0]).shape == (2, 4)
+    assert np.isfinite(np.asarray(got[0])).all()
+
+
+def test_gru_unit_layer(rng):
+    import paddle_tpu.framework as framework
+
+    framework.reset_default_programs()
+    x = fluid.layers.data(name="x", shape=[12], dtype="float32")
+    h0 = fluid.layers.data(name="h", shape=[4], dtype="float32")
+    out, _, _ = fluid.layers.gru_unit(x, h0, 12)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    got = exe.run(fluid.default_main_program(),
+                  feed={"x": rng.randn(2, 12).astype("float32"),
+                        "h": rng.randn(2, 4).astype("float32")},
+                  fetch_list=[out])
+    assert np.asarray(got[0]).shape == (2, 4)
+
+
+def test_chunk_eval_trailing_outside_regression():
+    """Review regression: trailing O tags must not poison the chunk min."""
+    inf = np.array([0, 2], np.int64)   # B O -> chunk [0,0]
+    lab = np.array([0, 0], np.int64)   # B B -> chunks [0,0], [1,1]
+    outs = _fetch_op(
+        "chunk_eval",
+        {"Inference": [("i", inf)], "Label": [("l", lab)]},
+        {"chunk_scheme": "IOB", "num_chunk_types": 1},
+        ["Precision", "Recall", "F1-Score", "NumInferChunks",
+         "NumLabelChunks", "NumCorrectChunks"],
+        {"i": inf, "l": lab})
+    p, r, f1, ni, nl, nc = (np.asarray(o) for o in outs)
+    assert ni[0] == 1 and nl[0] == 2 and nc[0] == 1
+    assert p[0] == pytest.approx(1.0)
+
+    # leading O before the first chunk: id -1 clamp must not poison chunk 0
+    inf2 = np.array([2, 0], np.int64)  # O B -> chunk [1,1]
+    lab2 = np.array([0, 0], np.int64)  # B B
+    outs = _fetch_op(
+        "chunk_eval",
+        {"Inference": [("i", inf2)], "Label": [("l", lab2)]},
+        {"chunk_scheme": "IOB", "num_chunk_types": 1},
+        ["Precision", "Recall", "F1-Score", "NumInferChunks",
+         "NumLabelChunks", "NumCorrectChunks"],
+        {"i": inf2, "l": lab2})
+    _, _, _, ni2, nl2, nc2 = (np.asarray(o) for o in outs)
+    assert ni2[0] == 1 and nl2[0] == 2 and nc2[0] == 1
+
+
+def test_array_to_lod_tensor_roundtrip_rows():
+    """Review regression: round trip must restore the packed row count."""
+    import paddle_tpu.framework as framework
+
+    framework.reset_default_programs()
+    x = _rank_table_fixture()
+    v = fluid.layers.data(name="x", shape=[2], dtype="float32", lod_level=1)
+    table = fluid.layers.lod_rank_table(v)
+    arr = fluid.layers.lod_tensor_to_array(v, table)
+    back = fluid.layers.array_to_lod_tensor(arr, table)
+    exe = fluid.Executor(fluid.CPUPlace())
+    got = exe.run(fluid.default_main_program(), feed={"x": x},
+                  fetch_list=[back])[0]
+    assert got.data.shape == x.data.shape
+    # valid rows must round-trip exactly (row 7 is padding)
+    np.testing.assert_allclose(np.asarray(got.data)[:7],
+                               np.asarray(x.data)[:7])
+
+
+def test_positive_negative_pair_blocked_matches_dense(rng):
+    """Blocked path (n > blk) must equal the single-slab path."""
+    n = 50
+    score = rng.randn(n).astype("float32")
+    label = rng.randint(0, 3, n).astype("float32")
+    qid = rng.randint(0, 5, n).astype("int32")
+
+    def brute():
+        pos = neg = neu = 0
+        for i in range(n):
+            for j in range(i + 1, n):
+                if qid[i] != qid[j] or label[i] == label[j]:
+                    continue
+                if score[i] == score[j]:
+                    neu += 1
+                elif (score[i] > score[j]) == (label[i] > label[j]):
+                    pos += 1
+                else:
+                    neg += 1
+        return pos, neg, neu
+
+    import paddle_tpu.ops.metric_ops as m
+    outs = _fetch_op(
+        "positive_negative_pair",
+        {"Score": [("s", score)], "Label": [("l", label)],
+         "QueryID": [("q", qid)]},
+        {}, ["PositivePair", "NegativePair", "NeutralPair"],
+        {"s": score, "l": label, "q": qid})
+    got = tuple(int(np.asarray(o)[0]) for o in outs)
+    want = brute()
+    assert got == (want[0], want[1], want[2])
