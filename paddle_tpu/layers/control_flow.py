@@ -372,13 +372,15 @@ def max_sequence_len(rank_table: Variable, **kwargs):
     return out
 
 
-def lod_tensor_to_array(x: Variable, table: Variable, **kwargs):
+def lod_tensor_to_array(x: Variable, table: Variable, max_len=None,
+                        **kwargs):
     helper = LayerHelper("lod_tensor_to_array", **kwargs)
     out = helper.block.create_var(name=helper.name, dtype=x.dtype,
                                   type=framework.VarType.LOD_TENSOR_ARRAY)
     helper.append_op(type="lod_tensor_to_array",
                      inputs={"X": [x], "RankTable": [table]},
-                     outputs={"Out": [out]})
+                     outputs={"Out": [out]},
+                     attrs={"max_len": max_len})
     return out
 
 

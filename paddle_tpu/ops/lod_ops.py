@@ -40,12 +40,18 @@ def _lod_rank_table(ctx):
                                        src_rows=x.data.shape[0]))
 
 
-def _batch_major(x: LoDArray, table: LoDRankTable):
-    """Packed rows -> (max_len, n_seq, D) ordered by rank table."""
+def _batch_major(x: LoDArray, table: LoDRankTable, max_len=None):
+    """Packed rows -> (max_len, n_seq, D) ordered by rank table.
+
+    ``max_len`` bounds the time dimension statically; without it the
+    only safe static bound is the total packed row count (a single
+    sequence could own every row), so callers that know their bucketed
+    max length should pass it (lod_tensor_to_array's max_len attr) to
+    keep downstream scans O(max_len), not O(total_rows)."""
     data = x.data
     off = x.last_level()
     nseq = off.shape[0] - 1
-    max_len = data.shape[0]  # static upper bound on any sequence length
+    max_len = int(max_len) if max_len else data.shape[0]
     ids = row_segment_ids(off, data.shape[0])          # seq id per row
     pos = jnp.arange(data.shape[0], dtype=jnp.int32) - jnp.take(
         off, jnp.minimum(ids, nseq - 1))               # step within sequence
@@ -65,7 +71,7 @@ def _lod_tensor_to_array(ctx):
     x = ctx.input("X")
     table = ctx.input("RankTable")
     assert isinstance(x, LoDArray) and isinstance(table, LoDRankTable)
-    bm = _batch_major(x, table)
+    bm = _batch_major(x, table, max_len=ctx.attr("max_len"))
     ctx.set_output("Out", TensorArray(bm, jnp.max(table.lengths).astype(jnp.int32)))
 
 

@@ -192,6 +192,12 @@ def _positive_negative_pair(ctx):
         neu = jnp.sum(valid & (s_cmp == 0))
         return pos, neu, jnp.sum(valid)
 
+    if n == 0:
+        zero = jnp.zeros(1, jnp.float32)
+        ctx.set_output("PositivePair", zero)
+        ctx.set_output("NegativePair", zero)
+        ctx.set_output("NeutralPair", zero)
+        return
     blk = min(n, 1024)
     n_blocks = -(-n // blk)
     if n_blocks == 1:

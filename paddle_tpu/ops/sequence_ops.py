@@ -265,3 +265,58 @@ def _act_fn(name):
         "relu": jax.nn.relu,
         "identity": lambda v: v,
     }[name]
+
+
+@register_op("expand_as_steps", inputs=("X", "Y"), diff_inputs=("X",))
+def _expand_as_steps(ctx):
+    """Broadcast a per-sequence vector X (B, D) to every step of the
+    padded sequence Y (B, T, ...) -> (B, T, D) (reference analog:
+    gserver ExpandLayer over LoD; here the batch is padded dense)."""
+    x = unwrap(ctx.input("X"))
+    y = unwrap(ctx.input("Y"))
+    t = y.shape[1]
+    ctx.set_output("Out", jnp.broadcast_to(x[:, None, :],
+                                           (x.shape[0], t, x.shape[-1])))
+
+
+@register_op("context_project", inputs=("X",))
+def _context_project(ctx):
+    """Sliding-window concat over time (reference: function/
+    ContextProjectionOp.cpp; v1 context_projection).  X (B, T, D) ->
+    (B, T, D * context_length): position t gets steps
+    [t+start, t+start+len) with zero padding past boundaries.  Pure
+    shifts + concat — XLA fuses it into the consumer matmul."""
+    x = unwrap(ctx.input("X"))
+    ctx_len = int(ctx.attr("context_length"))
+    start = int(ctx.attr("context_start", -(ctx_len // 2)))
+    B, T = x.shape[0], x.shape[1]
+    slabs = []
+    for k in range(ctx_len):
+        shift = start + k
+        if shift == 0:
+            slabs.append(x)
+        elif shift > 0:
+            pad = jnp.zeros((B, min(shift, T)) + x.shape[2:], x.dtype)
+            slabs.append(jnp.concatenate([x[:, shift:], pad], axis=1))
+        else:
+            pad = jnp.zeros((B, min(-shift, T)) + x.shape[2:], x.dtype)
+            slabs.append(jnp.concatenate([pad, x[:, :shift]], axis=1))
+    ctx.set_output("Out", jnp.concatenate(slabs, axis=-1))
+
+
+@register_op("padded_sequence_softmax", inputs=("X", "Length"),
+             diff_inputs=("X",))
+def _padded_sequence_softmax(ctx):
+    """Softmax over the time dim of a padded (B, T) or (B, T, 1) score
+    tensor, masking steps >= Length (the padded-batch analog of the
+    LoD sequence_softmax op; reference: operators/sequence_softmax_op.cc)."""
+    x = unwrap(ctx.input("X"))
+    lens = unwrap(ctx.input("Length")).reshape(-1)
+    squeeze = x.ndim == 3
+    s = x[..., 0] if squeeze else x                    # (B, T)
+    t = s.shape[1]
+    valid = jnp.arange(t)[None, :] < lens[:, None]
+    s = jnp.where(valid, s, -1e9)
+    out = jax.nn.softmax(s.astype(jnp.float32), axis=1).astype(x.dtype)
+    out = jnp.where(valid, out, 0.0)
+    ctx.set_output("Out", out[..., None] if squeeze else out)

@@ -1,0 +1,65 @@
+"""PyDataProvider2 protocol (reference:
+python/paddle/trainer/PyDataProvider2.py:365-386 @provider decorator;
+C++ embedding paddle/gserver/dataproviders/PyDataProvider2.cpp:195).
+
+A provider is a generator ``fn(settings, filename) -> yields samples``
+decorated with ``@provider(input_types=...)``.  On TPU there is no C++
+embedding: the trainer calls the generator directly and the batch is
+assembled host-side by the data feeder."""
+
+from __future__ import annotations
+
+import functools
+
+from paddle_tpu.v2.data_type import (  # noqa: F401  (re-exported API)
+    dense_array, dense_vector, dense_vector_sequence, integer_value,
+    integer_value_sequence, sparse_binary_vector, sparse_vector)
+
+__all__ = [
+    "provider", "CacheType", "dense_vector", "dense_vector_sequence",
+    "integer_value", "integer_value_sequence", "sparse_binary_vector",
+    "sparse_vector", "dense_array",
+]
+
+
+class CacheType:
+    NO_CACHE = 0
+    CACHE_PASS_IN_MEM = 1
+
+
+class _ProviderSettings:
+    """The ``settings`` object handed to provider functions; carries
+    input_types plus any kwargs from define_py_data_sources2 args."""
+
+    def __init__(self, input_types, **kwargs):
+        self.input_types = input_types
+        self.logger = __import__("logging").getLogger("provider")
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+
+def provider(input_types=None, cache=CacheType.NO_CACHE,
+             should_shuffle=None, min_pool_size=-1, pool_size=-1,
+             can_over_batch_size=True, calc_batch_size=None,
+             init_hook=None, **outter_kwargs):
+    """Decorate a sample generator (reference PyDataProvider2.provider).
+
+    The decorated callable keeps the reference's calling convention
+    ``fn(obj, filename)`` but is invoked in-process; ``fn.input_types``
+    is inspected by define_py_data_sources2 to type the data layers."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(filename=None, *args, **kwargs):
+            settings = _ProviderSettings(input_types, **outter_kwargs)
+            if init_hook is not None:
+                init_hook(settings, file_list=[filename], **kwargs)
+                kwargs = {}
+            return fn(settings, filename, *args, **kwargs)
+
+        wrapper.input_types = input_types
+        wrapper.cache = cache
+        wrapper.is_provider = True
+        return wrapper
+
+    return deco

@@ -1,0 +1,6 @@
+"""v1 trainer package (reference: python/paddle/trainer/ —
+config_parser.py, PyDataProvider2.py, and the paddle_trainer CLI
+TrainerMain.cpp:30)."""
+
+from paddle_tpu.trainer.config_parser import parse_config  # noqa: F401
+from paddle_tpu.trainer.trainer import Trainer, train_from_config  # noqa: F401

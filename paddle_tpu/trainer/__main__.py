@@ -1,0 +1,5 @@
+import sys
+
+from paddle_tpu.trainer.trainer import main
+
+sys.exit(main())

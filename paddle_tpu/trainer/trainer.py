@@ -1,0 +1,150 @@
+"""v1 training driver (reference: paddle/trainer/Trainer.cpp:265
+Trainer::train / trainOnePass, TrainerInternal::trainOneBatch:66, CLI
+paddle/trainer/TrainerMain.cpp:30).
+
+The C++ trainer interpreted a GradientMachine per batch; here one
+compiled XLA step program (forward+backward+update) runs per batch and
+passes/checkpointing happen host-side."""
+
+from __future__ import annotations
+
+import importlib
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from paddle_tpu.trainer.config_parser import TrainerConfig, parse_config
+
+
+class Trainer:
+    """Drives a parsed v1 config: builds the topology on the v2 training
+    stack, iterates the PyDataProvider2 generator, saves per-pass
+    parameter dirs (reference ParamUtil::saveParameters save_dir/
+    pass-%05d)."""
+
+    def __init__(self, conf: TrainerConfig, use_tpu: bool = True):
+        from paddle_tpu.v2 import parameters as v2_params
+        from paddle_tpu.v2.topology import Topology
+        from paddle_tpu.v2.trainer import SGD
+
+        if conf.cost is None:
+            raise ValueError("config declared no outputs(); nothing to train")
+        self.conf = conf
+        settings = dict(conf.opt_config or {})
+        lr = settings.get("learning_rate", 1e-3)
+        method = settings.get("learning_method")
+        optimizer = (method.to_optimizer(lr) if method is not None else None)
+        if optimizer is None:
+            from paddle_tpu import optimizer as opt
+
+            optimizer = opt.SGD(learning_rate=lr)
+        self.batch_size = settings.get("batch_size", 32)
+        topo = Topology(conf.cost, extra_layers=conf.evaluators)
+        params = v2_params.Parameters(topo)
+        self._sgd = SGD(cost=conf.cost, parameters=params,
+                        update_equation=optimizer)
+        self.parameters = params
+
+    # -- data ---------------------------------------------------------------
+
+    def _reader_from_sources(self, train: bool = True):
+        src = self.conf.data_sources
+        if src is None:
+            raise ValueError("config has no define_py_data_sources2")
+        mod = src["module"]
+        if isinstance(mod, str):
+            mod = importlib.import_module(mod)
+        provider = getattr(mod, src["obj"])
+        files = src["train_list"] if train else src["test_list"]
+        if isinstance(files, str):
+            if os.path.exists(files):
+                with open(files) as f:
+                    files = [l.strip() for l in f if l.strip()]
+            else:
+                files = [files]
+        files = files or [None]
+        batch_size = self.batch_size
+        feed_order = [name for name, _ in self._sgd.topology.feed_types]
+
+        def reader():
+            batch = []
+            for fname in files:
+                for sample in provider(fname, **src.get("args", {})):
+                    if isinstance(sample, dict):  # dict-yield protocol
+                        sample = tuple(sample[n] for n in feed_order)
+                    batch.append(sample)
+                    if len(batch) == batch_size:
+                        yield batch
+                        batch = []
+            if batch:
+                yield batch
+
+        return reader
+
+    # -- training -----------------------------------------------------------
+
+    def train(self, num_passes: int = 1, save_dir: Optional[str] = None,
+              log_period: int = 100, event_handler=None):
+        from paddle_tpu.v2 import event as v2_event
+
+        costs = []
+
+        def handler(e):
+            if isinstance(e, v2_event.EndIteration):
+                costs.append(e.cost)
+                if e.batch_id % log_period == 0:
+                    print(f"Pass {e.pass_id}, Batch {e.batch_id}, "
+                          f"Cost {e.cost:.6f}", flush=True)
+            if isinstance(e, v2_event.EndPass) and save_dir:
+                pass_dir = os.path.join(save_dir, f"pass-{e.pass_id:05d}")
+                os.makedirs(pass_dir, exist_ok=True)
+                self.parameters.to_tar(
+                    open(os.path.join(pass_dir, "params.tar"), "wb"))
+            if event_handler is not None:
+                event_handler(e)
+
+        self._sgd.train(self._reader_from_sources(train=True),
+                        num_passes=num_passes, event_handler=handler)
+        return costs
+
+    def test(self):
+        return self._sgd.test(self._reader_from_sources(train=False))
+
+
+def train_from_config(config_path: str, num_passes: int = 1,
+                      save_dir: Optional[str] = None,
+                      config_args: str = "", **kwargs):
+    conf = parse_config(config_path, config_args)
+    t = Trainer(conf)
+    costs = t.train(num_passes=num_passes, save_dir=save_dir, **kwargs)
+    return t, costs
+
+
+def main(argv=None):
+    """``python -m paddle_tpu.trainer --config=conf.py`` — the
+    paddle_trainer CLI surface (reference TrainerMain.cpp flags
+    --config/--num_passes/--save_dir/--config_args)."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="paddle_trainer")
+    p.add_argument("--config", required=True)
+    p.add_argument("--num_passes", type=int, default=1)
+    p.add_argument("--save_dir", default=None)
+    p.add_argument("--config_args", default="")
+    p.add_argument("--log_period", type=int, default=100)
+    p.add_argument("--use_gpu", default=None, help="ignored (TPU build)")
+    p.add_argument("--trainer_count", type=int, default=1,
+                   help="data-parallel shards (devices on the mesh)")
+    a = p.parse_args(argv)
+    t0 = time.time()
+    _, costs = train_from_config(a.config, num_passes=a.num_passes,
+                                 save_dir=a.save_dir,
+                                 config_args=a.config_args,
+                                 log_period=a.log_period)
+    dt = time.time() - t0
+    final = float(np.mean(costs[-10:])) if costs else float("nan")
+    print(f"Training done: {len(costs)} batches in {dt:.1f}s, "
+          f"final cost {final:.6f}", flush=True)
+    return 0

@@ -1,0 +1,20 @@
+"""v1 config DSL (reference: python/paddle/trainer_config_helpers/ —
+layers.py's 137 constructors, networks.py compositions, optimizers.py
+``settings``, attrs/poolings/activations).
+
+v1 configs are Python files that call ``settings(...)``, build a layer
+graph with ``*_layer`` constructors, and declare ``outputs(...)``;
+``paddle_tpu.trainer.config_parser.parse_config`` executes one and
+returns the captured model config.  The constructors here build the
+same lazy ``LayerOutput`` DAG the v2 API uses (paddle_tpu/v2/layer.py),
+so a parsed v1 config trains on the identical TPU Program path.
+"""
+
+from paddle_tpu.trainer_config_helpers.activations import *  # noqa: F401,F403
+from paddle_tpu.trainer_config_helpers.attrs import *  # noqa: F401,F403
+from paddle_tpu.trainer_config_helpers.poolings import *  # noqa: F401,F403
+from paddle_tpu.trainer_config_helpers.layers import *  # noqa: F401,F403
+from paddle_tpu.trainer_config_helpers.networks import *  # noqa: F401,F403
+from paddle_tpu.trainer_config_helpers.optimizers import *  # noqa: F401,F403
+from paddle_tpu.trainer_config_helpers.data_sources import *  # noqa: F401,F403
+from paddle_tpu.trainer_config_helpers.evaluators import *  # noqa: F401,F403

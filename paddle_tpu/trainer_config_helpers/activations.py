@@ -1,0 +1,29 @@
+"""v1 activation objects (reference:
+python/paddle/trainer_config_helpers/activations.py — the v2 module
+re-exports these under short names; here the aliasing goes the other
+way, onto paddle_tpu.v2.activation)."""
+
+from paddle_tpu.v2 import activation as _a
+
+__all__ = [
+    "BaseActivation", "LinearActivation", "IdentityActivation",
+    "ReluActivation", "SigmoidActivation", "TanhActivation",
+    "SoftmaxActivation", "ExpActivation", "LogActivation",
+    "SquareActivation", "SoftReluActivation", "BReluActivation",
+    "LeakyReluActivation", "STanhActivation",
+]
+
+BaseActivation = _a.BaseActivation
+LinearActivation = _a.Linear
+IdentityActivation = _a.Linear
+ReluActivation = _a.Relu
+SigmoidActivation = _a.Sigmoid
+TanhActivation = _a.Tanh
+SoftmaxActivation = _a.Softmax
+ExpActivation = _a.Exp
+LogActivation = _a.Log
+SquareActivation = _a.Square
+SoftReluActivation = _a.SoftRelu
+BReluActivation = _a.BRelu
+LeakyReluActivation = _a.LeakyRelu
+STanhActivation = _a.STanh

@@ -1,0 +1,56 @@
+"""v1 data source declaration (reference:
+python/paddle/trainer_config_helpers/data_sources.py
+define_py_data_sources2 — binds a PyDataProvider2 module/function to
+the config's data layers)."""
+
+from __future__ import annotations
+
+import importlib
+
+from paddle_tpu.trainer_config_helpers import layers as _layers
+
+__all__ = ["define_py_data_sources2"]
+
+
+def define_py_data_sources2(train_list, test_list, module, obj, args=None):
+    """Record the provider binding in the active config capture.  The
+    trainer resolves ``module.obj`` (decorated with @provider), calls it
+    per file in train_list/test_list, and retypes the config's data
+    layers from the provider's declared input_types."""
+    cap = _layers._g_capture
+    if cap is None:
+        raise RuntimeError("define_py_data_sources2 must run inside "
+                           "parse_config (a v1 config file)")
+    cap["data_sources"] = {
+        "train_list": train_list,
+        "test_list": test_list,
+        "module": module,
+        "obj": obj,
+        "args": args or {},
+    }
+    # retype data layers from the provider's declared input_types; also
+    # record them so parse_config can re-apply after the whole config
+    # ran (configs may declare sources before their data layers)
+    try:
+        mod = (module if not isinstance(module, str)
+               else importlib.import_module(module))
+        provider = getattr(mod, obj)
+        input_types = getattr(provider, "input_types", None)
+    except Exception:
+        input_types = None
+    if input_types:
+        cap["_pending_input_types"] = input_types
+        _apply_input_types(cap, input_types)
+
+
+def _apply_input_types(cap, input_types):
+    data_layers = cap.get("data_layers", {})
+    if isinstance(input_types, dict):
+        items = input_types.items()
+    else:  # positional: declaration order of data layers
+        items = zip(list(data_layers), input_types)
+    for name, t in items:
+        lo = data_layers.get(name)
+        if lo is not None:
+            lo.input_type = t
+            lo.is_seq = t.is_seq

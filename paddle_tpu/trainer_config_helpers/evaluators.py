@@ -1,0 +1,86 @@
+"""v1 evaluator declarations (reference:
+python/paddle/trainer_config_helpers/evaluators.py; runtime registry
+paddle/gserver/evaluators/Evaluator.cpp:172-1357).
+
+Evaluators attach metric layers as extra config outputs; the trainer
+fetches and prints them per batch/pass (reference TrainerInternal)."""
+
+from __future__ import annotations
+
+from paddle_tpu.trainer_config_helpers import layers as _layers
+from paddle_tpu.v2.layer import LayerOutput
+
+__all__ = [
+    "classification_error_evaluator", "auc_evaluator", "chunk_evaluator",
+    "precision_recall_evaluator", "pnpair_evaluator",
+]
+
+
+def _eval_layer(name_prefix, parents, build, size=1):
+    lo = LayerOutput(_layers._v2._uname(name_prefix), parents, build,
+                     size=size)
+    cap = _layers._g_capture
+    if cap is not None:
+        cap.setdefault("evaluators", []).append(lo)
+    return lo
+
+
+def classification_error_evaluator(input, label, name=None, **kwargs):
+    def build(ctx, pred, lab):
+        from paddle_tpu import layers as L
+
+        acc = L.accuracy(input=pred, label=lab)
+        return L.scale(acc, scale=-1.0, bias=1.0)  # error = 1 - accuracy
+
+    return _eval_layer("classification_error", [input, label], build)
+
+
+def auc_evaluator(input, label, name=None, **kwargs):
+    def build(ctx, pred, lab):
+        from paddle_tpu.trainer_config_helpers.layers import _op
+
+        _vals = None
+        return _op("auc", {"Out": [pred], "Indices": [pred], "Label": [lab]},
+                   out_slot="AUC")
+
+    return _eval_layer("auc", [input, label], build)
+
+
+def chunk_evaluator(input, label, chunk_scheme: str = "IOB",
+                    num_chunk_types: int = 1, name=None, **kwargs):
+    def build(ctx, inf, lab):
+        from paddle_tpu.trainer_config_helpers.layers import _op
+
+        return _op("chunk_eval", {"Inference": [inf], "Label": [lab]},
+                   attrs={"chunk_scheme": chunk_scheme,
+                          "num_chunk_types": num_chunk_types},
+                   out_slot="F1-Score")
+
+    return _eval_layer("chunk_f1", [input, label], build)
+
+
+def precision_recall_evaluator(input, label, name=None, **kwargs):
+    num_classes = input.size
+
+    def build(ctx, pred, lab):
+        from paddle_tpu.trainer_config_helpers.layers import _op
+
+        idx = _op("top_k", {"X": [pred]}, attrs={"k": 1},
+                  out_slot="Indices", dtype="int64")
+        return _op("precision_recall",
+                   {"MaxProbs": [pred], "Indices": [idx], "Labels": [lab]},
+                   attrs={"class_number": num_classes},
+                   out_slot="BatchMetrics")
+
+    return _eval_layer("precision_recall", [input, label], build)
+
+
+def pnpair_evaluator(input, label, query_id, name=None, **kwargs):
+    def build(ctx, score, lab, qid):
+        from paddle_tpu.trainer_config_helpers.layers import _op
+
+        return _op("positive_negative_pair",
+                   {"Score": [score], "Label": [lab], "QueryID": [qid]},
+                   out_slot="PositivePair")
+
+    return _eval_layer("pnpair", [input, label, query_id], build)

@@ -1,0 +1,734 @@
+"""v1 layer constructors (reference:
+python/paddle/trainer_config_helpers/layers.py — 7513 LoC, 137 public
+constructors, compiled by trainer/config_parser.py into a ModelConfig
+proto that the C++ layer engine interprets).
+
+TPU redesign: constructors return the same lazy ``LayerOutput`` DAG the
+v2 API uses (paddle_tpu/v2/layer.py); ``outputs()`` marks roots, and a
+module-level capture (driven by paddle_tpu.trainer.config_parser)
+records a LayerConfig-shaped dict per call so parsed configs can be
+inspected/diffed like the reference's protos.  Building the DAG traces
+straight into the Program IR — one compiled XLA program instead of a
+per-layer interpreter loop.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from paddle_tpu.trainer_config_helpers.activations import (
+    BaseActivation, LinearActivation, TanhActivation)
+from paddle_tpu.trainer_config_helpers.poolings import (BasePoolingType,
+                                                        MaxPooling)
+from paddle_tpu.v2 import data_type as _dt
+from paddle_tpu.v2 import layer as _v2
+from paddle_tpu.v2.layer import LayerOutput, SeqVal
+
+__all__ = [
+    "LayerOutput", "data_layer", "fc_layer", "embedding_layer",
+    "img_conv_layer", "img_pool_layer", "batch_norm_layer",
+    "dropout_layer", "lstmemory", "grumemory", "recurrent_layer",
+    "pooling_layer", "last_seq", "first_seq", "concat_layer",
+    "addto_layer", "mixed_layer", "full_matrix_projection",
+    "identity_projection", "table_projection", "dotmul_projection",
+    "trans_full_matrix_projection", "context_projection",
+    "classification_cost", "cross_entropy", "cross_entropy_cost",
+    "regression_cost", "mse_cost", "multi_binary_label_cross_entropy",
+    "huber_regression_cost", "hinge_cost", "sum_cost", "cos_sim",
+    "crf_layer", "crf_decoding_layer", "nce_layer", "maxid_layer",
+    "expand_layer", "repeat_layer", "power_layer", "scaling_layer",
+    "slope_intercept_layer", "interpolation_layer", "trans_layer",
+    "pad_layer", "outputs",
+]
+
+# ---------------------------------------------------------------------------
+# config capture (consumed by paddle_tpu.trainer.config_parser)
+# ---------------------------------------------------------------------------
+
+_g_capture: Optional[dict] = None
+
+
+def _begin_capture(cap: dict):
+    global _g_capture
+    _g_capture = cap
+
+
+def _end_capture():
+    global _g_capture
+    _g_capture = None
+
+
+def _record(lo: LayerOutput, type_: str, **cfg):
+    if _g_capture is not None:
+        entry = {"name": lo.name, "type": type_, "size": lo.size,
+                 "inputs": [p.name for p in lo.parents]}
+        entry.update(cfg)
+        _g_capture.setdefault("layers", []).append(entry)
+    return lo
+
+
+def _op(type_: str, inputs: dict, attrs=None, dtype="float32",
+        out_slot="Out", shape=None):
+    """Append one registered op and return its (single) output var."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    helper = LayerHelper("v1_" + type_)
+    out = helper.create_tmp_variable(dtype, shape)
+    helper.append_op(type=type_, inputs=inputs, outputs={out_slot: [out]},
+                     attrs=attrs or {})
+    return out
+
+
+def outputs(*layers):
+    """Declare config roots (reference: config_parser outputs())."""
+    flat = []
+    for l in layers:
+        flat.extend(l if isinstance(l, (list, tuple)) else [l])
+    if _g_capture is None:
+        raise RuntimeError(
+            "outputs() must run inside parse_config (a v1 config file)")
+    _g_capture.setdefault("outputs", []).extend(flat)
+
+
+# ---------------------------------------------------------------------------
+# data & dense layers
+# ---------------------------------------------------------------------------
+
+
+def data_layer(name: str, size: int, height: Optional[int] = None,
+               width: Optional[int] = None, **kwargs) -> LayerOutput:
+    """v1 data layers declare only a size; the *type* (dense vs integer
+    vs sequence) comes from the data provider's input_types
+    (reference: config_parser DataLayer + PyDataProvider2 protocol).
+    The build therefore reads ``lo.input_type`` lazily so
+    define_py_data_sources2 can retype it before the Topology builds."""
+
+    lo_box = []
+
+    def build(ctx):
+        from paddle_tpu import layers as L
+
+        type = lo_box[0].input_type
+        ctx.setdefault("@feeds", []).append((name, type))
+        if type.is_seq:
+            if type.dtype == "int64":
+                var = L.data(name=name, shape=[-1], dtype="int64",
+                             append_batch_size=False)
+                var.shape = (-1, -1)
+            else:
+                var = L.data(name=name, shape=[-1, type.dim],
+                             dtype=type.dtype, append_batch_size=False)
+                var.shape = (-1, -1, type.dim)
+            lens = L.data(name=name + "@len", shape=[-1], dtype="int32",
+                          append_batch_size=False)
+            return SeqVal(var, lens)
+        shape = [type.dim] if type.dtype != "int64" else [1]
+        return L.data(name=name, shape=shape, dtype=type.dtype)
+
+    lo = LayerOutput(name, [], build, size=size,
+                     input_type=_dt.dense_vector(size))
+    lo_box.append(lo)
+    lo.img_shape = (None, height, width) if height else None
+    if _g_capture is not None:
+        _g_capture.setdefault("input_layer_names", []).append(name)
+        _g_capture.setdefault("data_layers", {})[name] = lo
+    return _record(lo, "data", height=height, width=width)
+
+
+def fc_layer(input, size: int, act: Optional[BaseActivation] = None,
+             param_attr=None, bias_attr=None, name=None, layer_attr=None,
+             **kwargs) -> LayerOutput:
+    lo = _v2.fc(input=input, size=size, act=act or TanhActivation(),
+                param_attr=param_attr, bias_attr=bias_attr, name=name)
+    return _record(lo, "fc", active_type=(act or TanhActivation()).name)
+
+
+def embedding_layer(input, size: int, param_attr=None, name=None,
+                    **kwargs) -> LayerOutput:
+    lo = _v2.embedding(input=input, size=size, param_attr=param_attr,
+                       name=name)
+    return _record(lo, "mixed")  # reference emits a table-projection mixed
+
+
+# ---------------------------------------------------------------------------
+# image layers: v1 feeds flat (B, C*H*W) vectors; convs reshape using
+# num_channels and an inferred square image (config_parser.py does the
+# same shape bookkeeping via LayerConfig height/width)
+# ---------------------------------------------------------------------------
+
+
+def _to_image(ctx, x, parent: LayerOutput, num_channels):
+    from paddle_tpu import layers as L
+
+    if getattr(x, "ndim", None) == 2 or (x.shape is not None and len(x.shape) == 2):
+        c = num_channels or 1
+        img = getattr(parent, "img_shape", None)
+        if img and img[1]:
+            h = w = None
+            _, h, w = img
+        else:
+            hw = (parent.size or x.shape[-1]) // c
+            h = w = int(math.isqrt(hw))
+        return L.reshape(x, shape=[-1, c, h, w])
+    return x
+
+
+def img_conv_layer(input, filter_size, num_filters, num_channels=None,
+                   stride=1, padding=0, act=None, param_attr=None,
+                   bias_attr=None, groups=1, name=None, **kwargs):
+    def build(ctx, x):
+        from paddle_tpu import layers as L
+
+        x = _to_image(ctx, x, input, num_channels)
+        return L.conv2d(input=x, num_filters=num_filters,
+                        filter_size=filter_size, stride=stride,
+                        padding=padding, groups=groups,
+                        act=(act.name if act else None),
+                        param_attr=param_attr, bias_attr=bias_attr)
+
+    lo = LayerOutput(name or _v2._uname("conv"), [input], build,
+                     size=num_filters)
+    lo.num_channels = num_filters
+    return _record(lo, "exconv", num_filters=num_filters,
+                   filter_size=filter_size)
+
+
+def img_pool_layer(input, pool_size, stride=1, padding=0, pool_type=None,
+                   num_channels=None, name=None, **kwargs):
+    ptype = pool_type.name if isinstance(pool_type, BasePoolingType) else (
+        pool_type or "max")
+
+    def build(ctx, x):
+        from paddle_tpu import layers as L
+
+        x = _to_image(ctx, x, input, num_channels)
+        return L.pool2d(input=x, pool_size=pool_size, pool_type=ptype,
+                        pool_stride=stride, pool_padding=padding)
+
+    lo = LayerOutput(name or _v2._uname("pool"), [input], build,
+                     size=input.size)
+    lo.num_channels = getattr(input, "num_channels", num_channels)
+    return _record(lo, "pool", pool_type=ptype)
+
+
+def batch_norm_layer(input, act=None, name=None, num_channels=None,
+                     use_global_stats=None, **kwargs):
+    lo = _v2.batch_norm(input=input, act=act, name=name)
+    lo.num_channels = getattr(input, "num_channels", num_channels)
+    return _record(lo, "batch_norm")
+
+
+def dropout_layer(input, dropout_rate: float, name=None, **kwargs):
+    return _record(_v2.dropout(input=input, dropout_rate=dropout_rate,
+                               name=name), "dropout")
+
+
+# ---------------------------------------------------------------------------
+# recurrent layers
+# ---------------------------------------------------------------------------
+
+
+def lstmemory(input, size=None, reverse=False, act=None, name=None,
+              **kwargs):
+    return _record(_v2.lstmemory(input=input, size=size, reverse=reverse,
+                                 act=act, name=name), "lstmemory")
+
+
+def grumemory(input, size=None, reverse=False, act=None, name=None,
+              **kwargs):
+    # reference grumemory input is the 3h projection
+    h = size if size is not None else (input.size // 3 if input.size else None)
+    return _record(_v2.gru(input=input, size=h, reverse=reverse, name=name),
+                   "gated_recurrent")
+
+
+def recurrent_layer(input, size=None, act=None, reverse=False, name=None,
+                    **kwargs):
+    h = size if size is not None else input.size
+    return _record(_v2.simple_rnn(input=input, size=h, act=act,
+                                  reverse=reverse, name=name), "recurrent")
+
+
+# ---------------------------------------------------------------------------
+# sequence aggregation
+# ---------------------------------------------------------------------------
+
+
+def pooling_layer(input, pooling_type: Optional[BasePoolingType] = None,
+                  name=None, **kwargs):
+    return _record(_v2.pooling(input=input,
+                               pooling_type=pooling_type or MaxPooling(),
+                               name=name), "seqpool")
+
+
+def last_seq(input, name=None, **kwargs):
+    return _record(_v2.last_seq(input=input, name=name), "seqlastins")
+
+
+def first_seq(input, name=None, **kwargs):
+    return _record(_v2.first_seq(input=input, name=name), "seqfirstins")
+
+
+def expand_layer(input, expand_as, name=None, **kwargs):
+    """Broadcast a per-sequence vector to every step of ``expand_as``
+    (reference ExpandLayer)."""
+
+    def build(ctx, x, seq):
+        assert isinstance(seq, SeqVal)
+        out = _op("expand_as_steps", {"X": [x], "Y": [seq.var]},
+                  shape=(-1, -1, input.size or 0))
+        return SeqVal(out, seq.lengths)
+
+    lo = LayerOutput(name or _v2._uname("expand"), [input, expand_as], build,
+                     size=input.size, is_seq=True)
+    return _record(lo, "expand")
+
+
+def repeat_layer(input, num_repeats: int, name=None, **kwargs):
+    def build(ctx, x):
+        return _op("expand", {"X": [x]},
+                   attrs={"expand_times": [1, num_repeats]})
+
+    lo = LayerOutput(name or _v2._uname("repeat"), [input], build,
+                     size=(input.size or 0) * num_repeats)
+    return _record(lo, "featmap_expand")
+
+
+# ---------------------------------------------------------------------------
+# combination layers
+# ---------------------------------------------------------------------------
+
+
+def concat_layer(input: list, name=None, **kwargs):
+    return _record(_v2.concat(input=input, name=name), "concat")
+
+
+def addto_layer(input, act=None, bias_attr=None, name=None, **kwargs):
+    ins = input if isinstance(input, (list, tuple)) else [input]
+
+    def build(ctx, *vals):
+        from paddle_tpu import layers as L
+
+        dense = [v.var if isinstance(v, SeqVal) else v for v in vals]
+        out = dense[0]
+        for v in dense[1:]:
+            out = L.elementwise_add(out, v)
+        if act and act.name:
+            out = getattr(L, act.name)(out)
+        lens = next((v.lengths for v in vals if isinstance(v, SeqVal)), None)
+        return SeqVal(out, lens) if lens is not None else out
+
+    lo = LayerOutput(name or _v2._uname("addto"), list(ins), build,
+                     size=ins[0].size,
+                     is_seq=any(getattr(i, "is_seq", False) for i in ins))
+    lo.num_channels = getattr(ins[0], "num_channels", None)
+    return _record(lo, "addto")
+
+
+# --- mixed layer & projections (reference MixedLayer + Projection set) ---
+
+
+class _Projection:
+    def __init__(self, input: LayerOutput, build_fn, out_size=None):
+        self.input = input
+        self.build_fn = build_fn
+        self.out_size = out_size
+
+
+def full_matrix_projection(input, size: int = 0, param_attr=None, **kwargs):
+    def build(ctx, x, mixed_size):
+        from paddle_tpu import layers as L
+
+        return L.fc(input=x, size=mixed_size, bias_attr=False,
+                    param_attr=param_attr)
+
+    return _Projection(input, build, out_size=size or None)
+
+
+def trans_full_matrix_projection(input, size: int = 0, param_attr=None,
+                                 **kwargs):
+    return full_matrix_projection(input, size, param_attr)
+
+
+def identity_projection(input, offset: Optional[int] = None, **kwargs):
+    def build(ctx, x, mixed_size):
+        if offset:
+            return _op("slice_tensor", {"X": [x]},
+                       attrs={"axes": [1], "starts": [offset],
+                              "ends": [offset + mixed_size]})
+        return x
+
+    return _Projection(input, build, out_size=input.size)
+
+
+def table_projection(input, size: int = 0, param_attr=None, **kwargs):
+    def build(ctx, ids, mixed_size):
+        from paddle_tpu import layers as L
+
+        return L.embedding(input=ids, size=[input.size, mixed_size],
+                           param_attr=param_attr)
+
+    return _Projection(input, build, out_size=size or None)
+
+
+def dotmul_projection(input, param_attr=None, **kwargs):
+    def build(ctx, x, mixed_size):
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper("dotmul_proj", param_attr=param_attr)
+        w = helper.create_parameter(param_attr, shape=[mixed_size],
+                                    dtype="float32")
+        from paddle_tpu import layers as L
+
+        return L.elementwise_mul(x, w)
+
+    return _Projection(input, build, out_size=input.size)
+
+
+def context_projection(input, context_len: int, context_start=None,
+                       **kwargs):
+    def build(ctx, seq, mixed_size):
+        start = context_start if context_start is not None else \
+            -(context_len // 2)
+        out = _op("context_project",
+                  {"X": [seq.var if isinstance(seq, SeqVal) else seq]},
+                  attrs={"context_length": context_len,
+                         "context_start": start},
+                  shape=(-1, -1, (input.size or 0) * context_len))
+        return SeqVal(out, seq.lengths) if isinstance(seq, SeqVal) else out
+
+    return _Projection(input, build,
+                       out_size=(input.size or 0) * context_len)
+
+
+class mixed_layer:
+    """``with mixed_layer(size=..) as m: m += proj`` or
+    ``mixed_layer(size, input=[projections])`` (reference MixedLayerType,
+    layers.py mixed_layer)."""
+
+    def __new__(cls, size: int = 0, input=None, act=None, bias_attr=False,
+                name=None, **kwargs):
+        self = super().__new__(cls)
+        self._size = size
+        self._projs = []
+        self._act = act
+        self._bias = bias_attr
+        self._name = name
+        self._lo = None
+        if input is not None:
+            for p in (input if isinstance(input, (list, tuple)) else [input]):
+                self._add(p)
+            return self._finalize()
+        return self
+
+    def _add(self, proj):
+        if isinstance(proj, LayerOutput):  # bare layer = identity proj
+            proj = identity_projection(proj)
+        self._projs.append(proj)
+
+    def __iadd__(self, proj):
+        self._add(proj)
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self._finalize()
+        return False
+
+    def _finalize(self) -> LayerOutput:
+        projs = list(self._projs)
+        size = self._size or next(
+            (p.out_size for p in projs if p.out_size), None)
+        act = self._act
+        bias = self._bias
+        parents = [p.input for p in projs]
+
+        def build(ctx, *vals):
+            from paddle_tpu import layers as L
+
+            total = None
+            lens = None
+            for p, v in zip(projs, vals):
+                contrib = p.build_fn(ctx, v, size)
+                if isinstance(contrib, SeqVal):
+                    lens = contrib.lengths
+                    contrib = contrib.var
+                total = contrib if total is None else L.elementwise_add(
+                    total, contrib)
+            if bias:
+                from paddle_tpu.layer_helper import LayerHelper
+
+                helper = LayerHelper("mixed_bias")
+                b = helper.create_parameter(None, shape=[size],
+                                            dtype="float32", is_bias=True)
+                total = L.elementwise_add(total, b)
+            if act and act.name:
+                total = getattr(L, act.name)(total)
+            return SeqVal(total, lens) if lens is not None else total
+
+        lo = LayerOutput(self._name or _v2._uname("mixed"), parents, build,
+                         size=size)
+        self._lo = _record(lo, "mixed",
+                           active_type=(act.name if act else None))
+        return self._lo
+
+    # allow using the context-managed object where a LayerOutput is expected
+    def __getattr__(self, item):
+        lo = object.__getattribute__(self, "_lo")
+        if lo is None:
+            raise AttributeError(item)
+        return getattr(lo, item)
+
+
+# ---------------------------------------------------------------------------
+# elementwise / math layers
+# ---------------------------------------------------------------------------
+
+
+def _unary(name_prefix, op_build, parent, size=None, rec=None):
+    lo = LayerOutput(_v2._uname(name_prefix), [parent], op_build,
+                     size=size if size is not None else parent.size,
+                     is_seq=getattr(parent, "is_seq", False))
+    return _record(lo, rec or name_prefix)
+
+
+def power_layer(input, power: float, name=None, **kwargs):
+    def build(ctx, x):
+        from paddle_tpu import layers as L
+
+        v = x.var if isinstance(x, SeqVal) else x
+        out = L.pow(v, factor=power)
+        return SeqVal(out, x.lengths) if isinstance(x, SeqVal) else out
+
+    return _unary("power", build, input)
+
+
+def scaling_layer(input, weight, name=None, **kwargs):
+    """Row-wise scale: weight is (B, 1) (reference ScalingLayer)."""
+
+    def build(ctx, w, x):
+        from paddle_tpu import layers as L
+
+        wv = w.var if isinstance(w, SeqVal) else w
+        xv = x.var if isinstance(x, SeqVal) else x
+        # axis=0: the (B,) / (B, T, 1) weight aligns to x's leading dims
+        # (paddle broadcast rule, operators/elementwise_op_function.h)
+        out = L.elementwise_mul(xv, wv, axis=0)
+        return SeqVal(out, x.lengths) if isinstance(x, SeqVal) else out
+
+    lo = LayerOutput(name or _v2._uname("scaling"), [weight, input], build,
+                     size=input.size,
+                     is_seq=getattr(input, "is_seq", False))
+    return _record(lo, "scaling")
+
+
+def slope_intercept_layer(input, slope: float = 1.0, intercept: float = 0.0,
+                          name=None, **kwargs):
+    def build(ctx, x):
+        from paddle_tpu import layers as L
+
+        return L.scale(x, scale=slope, bias=intercept)
+
+    return _unary("slope_intercept", build, input)
+
+
+def interpolation_layer(input, weight, name=None, **kwargs):
+    """out = w * x1 + (1 - w) * x2 (reference InterpolationLayer)."""
+    x1, x2 = input
+
+    def build(ctx, w, a, b):
+        from paddle_tpu import layers as L
+
+        return L.elementwise_add(L.elementwise_mul(a, w),
+                                 L.elementwise_mul(b, L.scale(w, scale=-1.0,
+                                                              bias=1.0)))
+
+    lo = LayerOutput(name or _v2._uname("interp"), [weight, x1, x2], build,
+                     size=x1.size)
+    return _record(lo, "interpolation")
+
+
+def trans_layer(input, name=None, **kwargs):
+    def build(ctx, x):
+        from paddle_tpu import layers as L
+
+        return L.transpose(x, perm=[1, 0])
+
+    return _unary("trans", build, input)
+
+
+def pad_layer(input, pad_c=None, pad_h=None, pad_w=None, name=None,
+              **kwargs):
+    def build(ctx, x):
+        paddings = []
+        for dim_pad in ([0, 0], pad_c or [0, 0], pad_h or [0, 0],
+                        pad_w or [0, 0]):
+            paddings.extend(dim_pad)
+        return _op("pad", {"X": [x]}, attrs={"paddings": paddings})
+
+    return _unary("pad", build, input)
+
+
+def cos_sim(a, b, scale: float = 1.0, name=None, **kwargs):
+    def build(ctx, x, y):
+        from paddle_tpu import layers as L
+
+        return L.scale(_op("cos_sim", {"X": [x], "Y": [y]}), scale=scale)
+
+    lo = LayerOutput(name or _v2._uname("cos_sim"), [a, b], build, size=1)
+    return _record(lo, "cos")
+
+
+def maxid_layer(input, name=None, **kwargs):
+    return _record(_v2.max_id(input=input, name=name), "maxid")
+
+
+# ---------------------------------------------------------------------------
+# cost layers
+# ---------------------------------------------------------------------------
+
+
+def classification_cost(input, label, name=None, evaluator=None, **kwargs):
+    return _record(_v2.classification_cost(input=input, label=label,
+                                           name=name), "multi-class-cross-entropy")
+
+
+cross_entropy = classification_cost
+cross_entropy_cost = classification_cost
+
+
+def regression_cost(input, label, name=None, **kwargs):
+    return _record(_v2.mse_cost(input=input, label=label, name=name),
+                   "square_error")
+
+
+mse_cost = regression_cost
+
+
+def multi_binary_label_cross_entropy(input, label, name=None, **kwargs):
+    def build(ctx, pred, lab):
+        from paddle_tpu import layers as L
+
+        return L.mean(_op("sigmoid_cross_entropy_with_logits",
+                          {"X": [pred], "Label": [lab]}))
+
+    lo = LayerOutput(name or _v2._uname("mbce"), [input, label], build, size=1)
+    return _record(lo, "multi_binary_label_cross_entropy")
+
+
+def huber_regression_cost(input, label, delta: float = 1.0, name=None,
+                          **kwargs):
+    def build(ctx, pred, lab):
+        from paddle_tpu import layers as L
+
+        return L.mean(_op("huber_loss", {"X": [pred], "Y": [lab]},
+                          attrs={"delta": delta}, out_slot="Out"))
+
+    lo = LayerOutput(name or _v2._uname("huber"), [input, label], build,
+                     size=1)
+    return _record(lo, "huber_regression")
+
+
+def hinge_cost(input, label, name=None, **kwargs):
+    def build(ctx, pred, lab):
+        from paddle_tpu import layers as L
+
+        return L.mean(_op("hinge_loss", {"Logits": [pred], "Labels": [lab]},
+                          out_slot="Loss"))
+
+    lo = LayerOutput(name or _v2._uname("hinge"), [input, label], build,
+                     size=1)
+    return _record(lo, "hinge")
+
+
+def sum_cost(input, name=None, **kwargs):
+    def build(ctx, x):
+        from paddle_tpu import layers as L
+
+        return L.reduce_sum(x)
+
+    lo = LayerOutput(name or _v2._uname("sum_cost"), [input], build, size=1)
+    return _record(lo, "sum_cost")
+
+
+def crf_layer(input, label, size=None, param_attr=None, name=None, **kwargs):
+    """Linear-chain CRF NLL (reference CRFLayer / LinearChainCRF.cpp)."""
+    d = size or input.size
+
+    def build(ctx, em, lab):
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper("crf", param_attr=param_attr)
+        em_var = em.var if isinstance(em, SeqVal) else em
+        lens = em.lengths if isinstance(em, SeqVal) else None
+        tr = helper.create_parameter(param_attr, shape=[d + 2, d],
+                                     dtype="float32")
+        ll = helper.create_tmp_variable("float32", None)
+        ins = {"Emission": [em_var], "Transition": [tr],
+               "Label": [lab.var if isinstance(lab, SeqVal) else lab]}
+        if lens is not None:
+            ins["Length"] = [lens]
+        helper.append_op(type="linear_chain_crf", inputs=ins,
+                         outputs={"LogLikelihood": [ll]})
+        from paddle_tpu import layers as L
+
+        return L.mean(ll)
+
+    lo = LayerOutput(name or _v2._uname("crf"), [input, label], build, size=1)
+    return _record(lo, "crf")
+
+
+def crf_decoding_layer(input, size=None, label=None, param_attr=None,
+                       name=None, **kwargs):
+    d = size or input.size
+
+    def build(ctx, em, *rest):
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper("crf_decoding", param_attr=param_attr)
+        em_var = em.var if isinstance(em, SeqVal) else em
+        tr = helper.create_parameter(param_attr, shape=[d + 2, d],
+                                     dtype="float32")
+        path = helper.create_tmp_variable("int64", None)
+        ins = {"Emission": [em_var], "Transition": [tr]}
+        if isinstance(em, SeqVal):
+            ins["Length"] = [em.lengths]
+        helper.append_op(type="crf_decoding", inputs=ins,
+                         outputs={"ViterbiPath": [path]})
+        return path
+
+    parents = [input] + ([label] if label is not None else [])
+    lo = LayerOutput(name or _v2._uname("crf_dec"), parents, build,
+                     size=input.size)
+    return _record(lo, "crf_decoding")
+
+
+def nce_layer(input, label, num_classes: int, num_neg_samples: int = 10,
+              param_attr=None, bias_attr=None, name=None, **kwargs):
+    def build(ctx, x, lab):
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper("nce", param_attr=param_attr,
+                             bias_attr=bias_attr)
+        d = input.size
+        w = helper.create_parameter(param_attr, shape=[num_classes, d],
+                                    dtype="float32")
+        b = helper.create_parameter(bias_attr, shape=[num_classes],
+                                    dtype="float32", is_bias=True)
+        cost = helper.create_tmp_variable("float32", None)
+        helper.append_op(
+            type="nce",
+            inputs={"Input": [x], "Label": [lab], "Weight": [w], "Bias": [b]},
+            outputs={"Cost": [cost]},
+            attrs={"num_total_classes": num_classes,
+                   "num_neg_samples": num_neg_samples})
+        from paddle_tpu import layers as L
+
+        return L.mean(cost)
+
+    lo = LayerOutput(name or _v2._uname("nce"), [input, label], build, size=1)
+    return _record(lo, "nce")

@@ -1,0 +1,159 @@
+"""v1 network compositions (reference:
+python/paddle/trainer_config_helpers/networks.py — 1733 LoC:
+simple_img_conv_pool, img_conv_bn_pool, simple_lstm, simple_gru,
+bidirectional_lstm, sequence_conv_pool, simple_attention, ...)."""
+
+from __future__ import annotations
+
+from paddle_tpu.trainer_config_helpers import layers as _l
+from paddle_tpu.trainer_config_helpers.activations import (
+    LinearActivation, ReluActivation, SigmoidActivation, TanhActivation)
+from paddle_tpu.trainer_config_helpers.poolings import MaxPooling
+
+__all__ = [
+    "simple_img_conv_pool", "img_conv_bn_pool", "img_conv_group",
+    "simple_lstm", "simple_gru", "bidirectional_lstm",
+    "sequence_conv_pool", "text_conv_pool", "simple_attention",
+]
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         num_channel=None, pool_type=None, act=None,
+                         groups=1, conv_stride=1, conv_padding=0,
+                         pool_stride=1, pool_padding=0, name=None,
+                         param_attr=None, **kwargs):
+    conv = _l.img_conv_layer(
+        input=input, filter_size=filter_size, num_filters=num_filters,
+        num_channels=num_channel, stride=conv_stride, padding=conv_padding,
+        groups=groups, act=act or ReluActivation(), param_attr=param_attr,
+        name=name and name + "_conv")
+    return _l.img_pool_layer(
+        input=conv, pool_size=pool_size, stride=pool_stride,
+        padding=pool_padding, pool_type=pool_type or MaxPooling(),
+        name=name and name + "_pool")
+
+
+def img_conv_bn_pool(input, filter_size, num_filters, pool_size,
+                     num_channel=None, pool_type=None, act=None,
+                     conv_stride=1, conv_padding=0, pool_stride=1,
+                     name=None, **kwargs):
+    conv = _l.img_conv_layer(
+        input=input, filter_size=filter_size, num_filters=num_filters,
+        num_channels=num_channel, stride=conv_stride, padding=conv_padding,
+        act=LinearActivation(), name=name and name + "_conv")
+    bn = _l.batch_norm_layer(input=conv, act=act or ReluActivation(),
+                             name=name and name + "_bn")
+    return _l.img_pool_layer(input=bn, pool_size=pool_size,
+                             stride=pool_stride,
+                             pool_type=pool_type or MaxPooling(),
+                             name=name and name + "_pool")
+
+
+def img_conv_group(input, conv_num_filter, pool_size, num_channels=None,
+                   conv_padding=1, conv_filter_size=3, conv_act=None,
+                   conv_with_batchnorm=False, pool_stride=2,
+                   pool_type=None, **kwargs):
+    """VGG-style conv block (reference networks.py img_conv_group)."""
+    tmp = input
+    chan = num_channels
+    for i, nf in enumerate(conv_num_filter):
+        tmp = _l.img_conv_layer(
+            input=tmp, filter_size=conv_filter_size, num_filters=nf,
+            num_channels=chan, padding=conv_padding,
+            act=(LinearActivation() if conv_with_batchnorm
+                 else (conv_act or ReluActivation())))
+        chan = None
+        if conv_with_batchnorm:
+            tmp = _l.batch_norm_layer(input=tmp,
+                                      act=conv_act or ReluActivation())
+    return _l.img_pool_layer(input=tmp, pool_size=pool_size,
+                             stride=pool_stride,
+                             pool_type=pool_type or MaxPooling())
+
+
+def simple_lstm(input, size, reverse=False, act=None, name=None,
+                mat_param_attr=None, bias_param_attr=None,
+                lstm_cell_attr=None, **kwargs):
+    """fc(4h) -> lstmemory (reference networks.py simple_lstm)."""
+    proj = _l.fc_layer(input=input, size=size * 4, act=LinearActivation(),
+                       param_attr=mat_param_attr, bias_attr=bias_param_attr,
+                       name=name and name + "_proj")
+    return _l.lstmemory(input=proj, size=size, reverse=reverse, act=act,
+                        name=name)
+
+
+def simple_gru(input, size, reverse=False, act=None, name=None, **kwargs):
+    proj = _l.fc_layer(input=input, size=size * 3, act=LinearActivation(),
+                       name=name and name + "_proj")
+    return _l.grumemory(input=proj, size=size, reverse=reverse, act=act,
+                        name=name)
+
+
+def bidirectional_lstm(input, size, return_seq=False, name=None, **kwargs):
+    fwd = simple_lstm(input=input, size=size, reverse=False,
+                      name=name and name + "_fw")
+    bwd = simple_lstm(input=input, size=size, reverse=True,
+                      name=name and name + "_bw")
+    if return_seq:
+        return _l.concat_layer(input=[fwd, bwd], name=name)
+    return _l.concat_layer(
+        input=[_l.last_seq(input=fwd), _l.first_seq(input=bwd)], name=name)
+
+
+def sequence_conv_pool(input, context_len, hidden_size,
+                       context_start=None, pool_type=None,
+                       context_proj_param_attr=None, fc_param_attr=None,
+                       fc_act=None, name=None, **kwargs):
+    """context window -> fc -> seq pool (reference networks.py
+    sequence_conv_pool — the quick-start text classifier backbone)."""
+    with _l.mixed_layer(size=(input.size or 0) * context_len,
+                        name=name and name + "_ctx") as m:
+        m += _l.context_projection(input, context_len=context_len,
+                                   context_start=context_start)
+    ctx_out = m._lo
+    ctx_out.is_seq = True
+    fc = _l.fc_layer(input=ctx_out, size=hidden_size,
+                     act=fc_act or TanhActivation(),
+                     param_attr=fc_param_attr, name=name and name + "_fc")
+    return _l.pooling_layer(input=fc, pooling_type=pool_type or MaxPooling(),
+                            name=name)
+
+
+text_conv_pool = sequence_conv_pool
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state,
+                     transform_param_attr=None, softmax_param_attr=None,
+                     name=None, **kwargs):
+    """Bahdanau-style additive attention over a padded sequence
+    (reference networks.py simple_attention)."""
+    from paddle_tpu.trainer_config_helpers.poolings import SumPooling
+    from paddle_tpu.v2.layer import LayerOutput, SeqVal
+
+    expanded = _l.expand_layer(input=decoder_state,
+                               expand_as=encoded_proj,
+                               name=name and name + "_expand")
+    combined = _l.addto_layer(input=[encoded_proj, expanded],
+                              act=TanhActivation(),
+                              name=name and name + "_combine")
+    att_score = _l.fc_layer(input=combined, size=1, act=LinearActivation(),
+                            param_attr=softmax_param_attr, bias_attr=False,
+                            name=name and name + "_weight")
+
+    # normalize over the valid steps (reference uses
+    # SequenceSoftmaxActivation on the weight fc)
+    def _softmax_build(ctx, s):
+        from paddle_tpu.trainer_config_helpers.layers import _op
+
+        assert isinstance(s, SeqVal)
+        out = _op("padded_sequence_softmax",
+                  {"X": [s.var], "Length": [s.lengths]},
+                  shape=(-1, -1, 1))
+        return SeqVal(out, s.lengths)
+
+    att_w = LayerOutput((name or "attn") + "_softmax", [att_score],
+                        _softmax_build, size=1, is_seq=True)
+    scaled = _l.scaling_layer(input=encoded_sequence, weight=att_w,
+                              name=name and name + "_scale")
+    return _l.pooling_layer(input=scaled, pooling_type=SumPooling(),
+                            name=name)

@@ -1,0 +1,278 @@
+"""Tests for the v1 config DSL: trainer_config_helpers, config_parser,
+PyDataProvider2, the paddle_trainer CLI path, and the new sequence ops
+behind it (context_project, expand_as_steps)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.trainer.config_parser import parse_config
+
+
+def _mnist_config():
+    from paddle_tpu.trainer_config_helpers import (
+        MomentumOptimizer, ReluActivation, SoftmaxActivation,
+        TanhActivation, classification_cost, data_layer, fc_layer, outputs,
+        settings)
+    from paddle_tpu.trainer_config_helpers.networks import \
+        simple_img_conv_pool
+
+    settings(batch_size=32, learning_rate=0.01,
+             learning_method=MomentumOptimizer(momentum=0.9))
+    img = data_layer(name="pixel", size=784)
+    conv = simple_img_conv_pool(input=img, filter_size=5, num_filters=4,
+                                num_channel=1, pool_size=2, pool_stride=2,
+                                act=ReluActivation())
+    fc1 = fc_layer(input=conv, size=32, act=TanhActivation())
+    pred = fc_layer(input=fc1, size=10, act=SoftmaxActivation())
+    label = data_layer(name="label", size=10)
+    outputs(classification_cost(input=pred, label=label))
+
+
+def test_parse_config_captures_model():
+    conf = parse_config(_mnist_config)
+    mc = conf.model_config
+    assert "pixel" in mc.input_layer_names
+    assert "label" in mc.input_layer_names
+    assert len(mc.output_layer_names) == 1
+    types = [l["type"] for l in mc.layers]
+    assert "data" in types and "fc" in types and "exconv" in types
+    assert "multi-class-cross-entropy" in types
+    assert conf.opt_config["batch_size"] == 32
+    assert conf.opt_config["learning_method"].name == "momentum"
+
+
+def test_parse_config_file_and_config_args(tmp_path):
+    cfg = tmp_path / "conf.py"
+    cfg.write_text(
+        "from paddle_tpu.trainer_config_helpers import *\n"
+        "hidden = get_config_arg('hidden', int, 8)\n"
+        "settings(batch_size=4, learning_rate=0.1)\n"
+        "x = data_layer(name='x', size=4)\n"
+        "y = data_layer(name='y', size=1)\n"
+        "h = fc_layer(input=x, size=hidden, act=TanhActivation())\n"
+        "pred = fc_layer(input=h, size=1, act=LinearActivation())\n"
+        "outputs(regression_cost(input=pred, label=y))\n")
+    conf = parse_config(str(cfg), "hidden=16")
+    fc_cfgs = [l for l in conf.model_config.layers if l["type"] == "fc"]
+    assert fc_cfgs[0]["size"] == 16
+
+
+def test_v1_mnist_trains(tmp_path):
+    from paddle_tpu.trainer import train_from_config
+
+    _, costs = train_from_config("demos/mnist_v1/trainer_config.py",
+                                 num_passes=2, log_period=100)
+    assert costs[0] > 1.5
+    assert np.mean(costs[-3:]) < costs[0] * 0.7
+
+
+def test_v1_quick_start_text_trains():
+    from paddle_tpu.trainer import train_from_config
+
+    _, costs = train_from_config("demos/quick_start/trainer_config.py",
+                                 num_passes=6, log_period=100)
+    assert np.mean(costs[-3:]) < 0.45, costs[-3:]
+
+
+def test_mixed_layer_full_matrix_projection():
+    """mixed(full_matrix_projection) must equal a bias-free linear fc."""
+    import paddle_tpu.framework as framework
+    from paddle_tpu.trainer_config_helpers import layers as v1
+
+    conf_holder = {}
+
+    def config():
+        x = v1.data_layer(name="x", size=6)
+        with v1.mixed_layer(size=4) as m:
+            m += v1.full_matrix_projection(input=x)
+        conf_holder["out"] = m._lo
+        v1.outputs(v1.sum_cost(input=m._lo))
+
+    conf = parse_config(config)
+    from paddle_tpu.v2.topology import Topology
+
+    topo = Topology(None, output_layers=[conf_holder["out"]])
+    exe = fluid.Executor(fluid.CPUPlace())
+    import paddle_tpu.executor as executor_mod
+
+    scope = executor_mod.Scope()
+    with executor_mod.scope_guard(scope):
+        exe.run(topo.startup_program)
+        xs = np.random.RandomState(0).randn(3, 6).astype("float32")
+        out = exe.run(topo.main_program, feed={"x": xs},
+                      fetch_list=[topo.output_vars[0]])[0]
+        w_name = topo.main_program.all_parameters()[0].name
+        w = np.asarray(scope.get(w_name))
+    np.testing.assert_allclose(np.asarray(out), xs @ w, atol=1e-5)
+
+
+def test_context_project_op():
+    import paddle_tpu.framework as framework
+
+    framework.reset_default_programs()
+    x = np.arange(12, dtype=np.float32).reshape(1, 4, 3)  # B=1 T=4 D=3
+    v = fluid.layers.data(name="x", shape=[4, 3], dtype="float32")
+    prog = fluid.default_main_program()
+    block = prog.global_block()
+    block.create_var(name="ctx_out", dtype="float32")
+    block.append_op(type="context_project", inputs={"X": ["x"]},
+                    outputs={"Out": ["ctx_out"]},
+                    attrs={"context_length": 3, "context_start": -1})
+    got = fluid.Executor(fluid.CPUPlace()).run(
+        prog, feed={"x": x}, fetch_list=["ctx_out"])[0]
+    got = np.asarray(got)
+    assert got.shape == (1, 4, 9)
+    # position 0: [zeros, step0, step1]
+    np.testing.assert_allclose(got[0, 0], np.concatenate(
+        [np.zeros(3), x[0, 0], x[0, 1]]))
+    # position 3 (last): [step2, step3, zeros]
+    np.testing.assert_allclose(got[0, 3], np.concatenate(
+        [x[0, 2], x[0, 3], np.zeros(3)]))
+
+
+def test_expand_as_steps_op():
+    import paddle_tpu.framework as framework
+
+    framework.reset_default_programs()
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)      # (B=2, D=2)
+    y = np.zeros((2, 3, 5), np.float32)                     # T=3
+    vx = fluid.layers.data(name="x", shape=[2], dtype="float32")
+    vy = fluid.layers.data(name="y", shape=[3, 5], dtype="float32")
+    prog = fluid.default_main_program()
+    block = prog.global_block()
+    block.create_var(name="exp_out", dtype="float32")
+    block.append_op(type="expand_as_steps", inputs={"X": ["x"], "Y": ["y"]},
+                    outputs={"Out": ["exp_out"]})
+    got = np.asarray(fluid.Executor(fluid.CPUPlace()).run(
+        prog, feed={"x": x, "y": y}, fetch_list=["exp_out"])[0])
+    assert got.shape == (2, 3, 2)
+    np.testing.assert_allclose(got[:, 1, :], x)
+
+
+def test_evaluator_capture():
+    from paddle_tpu.trainer_config_helpers import layers as v1
+    from paddle_tpu.trainer_config_helpers.evaluators import \
+        classification_error_evaluator
+
+    def config():
+        x = v1.data_layer(name="x", size=4)
+        lab = v1.data_layer(name="lab", size=3)
+        pred = v1.fc_layer(input=x, size=3)
+        classification_error_evaluator(input=pred, label=lab)
+        v1.outputs(v1.classification_cost(input=pred, label=lab))
+
+    conf = parse_config(config)
+    assert len(conf.evaluators) == 1
+
+
+def test_provider_decorator_metadata():
+    from paddle_tpu.trainer.PyDataProvider2 import (dense_vector,
+                                                    integer_value, provider)
+
+    @provider(input_types={"a": dense_vector(3), "b": integer_value(2)})
+    def p(settings, filename):
+        yield {"a": [0.0, 0.0, 0.0], "b": 1}
+
+    assert p.input_types["a"].dim == 3
+    rows = list(p(None))
+    assert rows[0]["b"] == 1
+
+
+def test_simple_attention_builds_and_normalizes():
+    """Review regression: attention must softmax weights over valid
+    steps and handle SeqVal through scaling_layer."""
+    from paddle_tpu.trainer_config_helpers import layers as v1
+    from paddle_tpu.trainer_config_helpers.networks import simple_attention
+    from paddle_tpu.v2 import data_type as dt
+    from paddle_tpu.v2 import layer as v2l
+    from paddle_tpu.v2.topology import Topology
+
+    holder = {}
+
+    def config():
+        enc = v2l.data(name="enc", type=dt.dense_vector_sequence(8))
+        proj = v2l.data(name="proj", type=dt.dense_vector_sequence(8))
+        state = v1.data_layer(name="state", size=8)
+        holder["out"] = simple_attention(encoded_sequence=enc,
+                                         encoded_proj=proj,
+                                         decoder_state=state)
+        v1.outputs(v1.sum_cost(input=holder["out"]))
+
+    parse_config(config)
+    topo = Topology(None, output_layers=[holder["out"]])
+    import paddle_tpu.executor as executor_mod
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = executor_mod.Scope()
+    rng = np.random.RandomState(3)
+    with executor_mod.scope_guard(scope):
+        exe.run(topo.startup_program)
+        out = exe.run(
+            topo.main_program,
+            feed={"enc": rng.randn(2, 5, 8).astype("float32"),
+                  "enc@len": np.array([5, 3], np.int32),
+                  "proj": rng.randn(2, 5, 8).astype("float32"),
+                  "proj@len": np.array([5, 3], np.int32),
+                  "state": rng.randn(2, 8).astype("float32")},
+            fetch_list=[topo.output_vars[0]])[0]
+    out = np.asarray(out)
+    assert out.shape == (2, 8)
+    assert np.isfinite(out).all()
+
+
+def test_precision_recall_evaluator_runs():
+    """Review regression: evaluator must wire the op's real slots."""
+    from paddle_tpu.trainer_config_helpers import layers as v1
+    from paddle_tpu.trainer_config_helpers.evaluators import \
+        precision_recall_evaluator
+    from paddle_tpu.v2.topology import Topology
+
+    holder = {}
+
+    def config():
+        x = v1.data_layer(name="x", size=4)
+        lab = v1.data_layer(name="lab", size=3)
+        pred = v1.fc_layer(input=x, size=3,
+                           act=__import__(
+                               "paddle_tpu.trainer_config_helpers.activations",
+                               fromlist=["SoftmaxActivation"]
+                           ).SoftmaxActivation())
+        holder["ev"] = precision_recall_evaluator(input=pred, label=lab)
+        v1.outputs(v1.classification_cost(input=pred, label=lab))
+
+    conf = parse_config(config)
+    # retype label to integer
+    conf.data_layers["lab"].input_type = __import__(
+        "paddle_tpu.v2.data_type", fromlist=["integer_value"]
+    ).integer_value(3)
+    topo = Topology(conf.cost, extra_layers=[holder["ev"]])
+    import paddle_tpu.executor as executor_mod
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = executor_mod.Scope()
+    rng = np.random.RandomState(5)
+    with executor_mod.scope_guard(scope):
+        exe.run(topo.startup_program)
+        outs = exe.run(
+            topo.main_program,
+            feed={"x": rng.randn(6, 4).astype("float32"),
+                  "lab": rng.randint(0, 3, (6, 1)).astype("int64")},
+            fetch_list=[topo.output_vars[1]])
+    metrics = np.asarray(outs[0])
+    assert metrics.shape[-1] == 6  # macro P/R/F1 + micro P/R/F1
+    assert np.isfinite(metrics).all()
+
+
+def test_provider_kwargs_forwarded():
+    """Review regression: define_py_data_sources2 args must reach the
+    provider generator."""
+    from paddle_tpu.trainer.PyDataProvider2 import integer_value, provider
+
+    @provider(input_types={"a": integer_value(10)})
+    def p(settings, filename, limit=3):
+        for i in range(limit):
+            yield {"a": i}
+
+    rows = list(p(None, limit=5))
+    assert len(rows) == 5
