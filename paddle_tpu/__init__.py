@@ -45,6 +45,7 @@ from paddle_tpu import io
 from paddle_tpu import evaluator
 from paddle_tpu import profiler
 from paddle_tpu.data_feeder import DataFeeder
+from paddle_tpu.param_attr import ParamAttr
 from paddle_tpu.lod import LoDArray, create_lod_array
 from paddle_tpu import parallel
 

@@ -83,8 +83,10 @@ def fc(
 
 def embedding(input, size, is_sparse: bool = False, padding_idx=None,
               param_attr=None, dtype="float32", **kwargs):
-    """size = [vocab, dim].  ``is_sparse`` is accepted for API parity; on
-    TPU the gradient is a dense XLA scatter-add either way."""
+    """size = [vocab, dim].  With ``is_sparse`` the gradient flows as a
+    static-shape SelectedRows (`paddle_tpu.sparse.SparseGrad`): only the
+    looked-up rows are carried and updated (reference:
+    operators/lookup_table_op.cc sparse path + framework/selected_rows.h)."""
     helper = LayerHelper("embedding", param_attr=param_attr, **kwargs)
     w = helper.create_parameter(
         param_attr, shape=list(size), dtype=dtype,

@@ -127,7 +127,15 @@ class LoDRankTable:
 
 
 def unwrap(x):
-    return x.data if isinstance(x, LoDArray) else x
+    if isinstance(x, LoDArray):
+        return x.data
+    # Safety net: any op that consumes a SelectedRows-style sparse grad
+    # without a dedicated sparse branch sees the equivalent dense tensor.
+    from paddle_tpu.sparse import SparseGrad
+
+    if isinstance(x, SparseGrad):
+        return x.to_dense()
+    return x
 
 
 def rewrap(template, data):

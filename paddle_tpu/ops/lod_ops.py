@@ -59,10 +59,14 @@ def _batch_major(x: LoDArray, table: LoDRankTable, max_len=None):
     rank_of = jnp.zeros(nseq, jnp.int32).at[table.index].set(
         jnp.arange(nseq, dtype=jnp.int32))
     col = jnp.take(rank_of, jnp.minimum(ids, nseq - 1))
-    valid = ids < nseq
+    # Steps at/beyond max_len are explicitly truncated (bucketing
+    # contract); without the pos bound they would alias into the
+    # sentinel slot and corrupt other rows.
+    valid = (ids < nseq) & (pos < max_len)
     flat_idx = jnp.where(valid, pos * nseq + col, max_len * nseq)
     buf = jnp.zeros((max_len * nseq + 1,) + data.shape[1:], data.dtype)
-    buf = buf.at[flat_idx].set(data)
+    buf = buf.at[flat_idx].set(jnp.where(
+        valid.reshape((-1,) + (1,) * (data.ndim - 1)), data, 0))
     return buf[:-1].reshape((max_len, nseq) + data.shape[1:])
 
 
@@ -71,8 +75,14 @@ def _lod_tensor_to_array(ctx):
     x = ctx.input("X")
     table = ctx.input("RankTable")
     assert isinstance(x, LoDArray) and isinstance(table, LoDRankTable)
-    bm = _batch_major(x, table, max_len=ctx.attr("max_len"))
-    ctx.set_output("Out", TensorArray(bm, jnp.max(table.lengths).astype(jnp.int32)))
+    max_len = ctx.attr("max_len")
+    bm = _batch_major(x, table, max_len=max_len)
+    size = jnp.max(table.lengths).astype(jnp.int32)
+    if max_len:
+        # Keep the scan bound consistent with the (possibly truncated)
+        # time dimension.
+        size = jnp.minimum(size, jnp.int32(int(max_len)))
+    ctx.set_output("Out", TensorArray(bm, size))
 
 
 @register_op("array_to_lod_tensor", inputs=("X", "RankTable"))

@@ -91,11 +91,20 @@ for name, fn in [
 
 @register_op("sum", inputs=("X",))
 def _sum(ctx):
-    xs = [unwrap(v) for v in ctx.inputs("X")]
+    from paddle_tpu.sparse import SparseGrad, concat_sparse
+
+    raw = ctx.inputs("X")
+    if all(isinstance(v, SparseGrad) for v in raw):
+        # Sum of SelectedRows = row concatenation (reference:
+        # operators/sum_op.h SelectedRows branch) — stays sparse.
+        ctx.set_output("Out", concat_sparse(raw))
+        return
+    xs = [unwrap(v) for v in raw]
     out = xs[0]
     for x in xs[1:]:
         out = out + x
-    ctx.set_output("Out", rewrap(ctx.inputs("X")[0], out))
+    template = next((v for v in raw if not isinstance(v, SparseGrad)), raw[0])
+    ctx.set_output("Out", rewrap(template, out))
 
 
 @register_op("scale", inputs=("X",))

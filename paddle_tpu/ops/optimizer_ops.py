@@ -20,21 +20,49 @@ def _lr(ctx):
 @register_op("sgd", inputs=("Param", "Grad", "LearningRate"),
              outputs=("ParamOut",), stop_gradient=True)
 def _sgd(ctx):
+    from paddle_tpu.sparse import is_sparse_grad
+
     p = unwrap(ctx.input("Param"))
-    g = unwrap(ctx.input("Grad"))
-    ctx.set_output("ParamOut", p - _lr(ctx).astype(p.dtype) * g.astype(p.dtype))
+    graw = ctx.input("Grad")
+    lr = _lr(ctx).astype(p.dtype)
+    if is_sparse_grad(graw):
+        # SelectedRows branch (reference: operators/sgd_op.cc sparse
+        # kernel): scatter-add touches only the looked-up rows;
+        # duplicate rows accumulate, which is exact for SGD.
+        out = p.at[graw.rows].add(-lr * graw.values.astype(p.dtype), mode="drop")
+        ctx.set_output("ParamOut", out)
+        return
+    g = unwrap(graw)
+    ctx.set_output("ParamOut", p - lr * g.astype(p.dtype))
 
 
 @register_op("momentum", inputs=("Param", "Grad", "Velocity", "LearningRate"),
              outputs=("ParamOut", "VelocityOut"), stop_gradient=True)
 def _momentum(ctx):
+    from paddle_tpu.sparse import is_sparse_grad, rowwise_update
+
     p = unwrap(ctx.input("Param"))
-    g = unwrap(ctx.input("Grad")).astype(p.dtype)
+    graw = ctx.input("Grad")
     v = unwrap(ctx.input("Velocity"))
     mu = ctx.attr("mu", 0.9)
     lr = _lr(ctx).astype(p.dtype)
+    nesterov = ctx.attr("use_nesterov", False)
+    if is_sparse_grad(graw):
+        # Row-wise lazy momentum: untouched rows keep their velocity
+        # (legacy SparseRowMatrix semantics, parameter/FirstOrderOptimizer.h).
+        def upd(p_rows, g_rows, v_rows):
+            v_new = mu * v_rows + g_rows
+            if nesterov:
+                return p_rows - (g_rows + mu * v_new) * lr, v_new
+            return p_rows - lr * v_new, v_new
+
+        p_new, v_new = rowwise_update(p, graw, upd, v)
+        ctx.set_output("ParamOut", p_new)
+        ctx.set_output("VelocityOut", v_new)
+        return
+    g = unwrap(graw).astype(p.dtype)
     v_new = mu * v + g
-    if ctx.attr("use_nesterov", False):
+    if nesterov:
         p_new = p - (g + mu * v_new) * lr
     else:
         p_new = p - lr * v_new
@@ -48,8 +76,9 @@ def _momentum(ctx):
              outputs=("ParamOut", "Moment1Out", "Moment2Out"),
              stop_gradient=True)
 def _adam(ctx):
+    from paddle_tpu.sparse import is_sparse_grad, rowwise_update
+
     p = unwrap(ctx.input("Param"))
-    g = unwrap(ctx.input("Grad")).astype(jnp.float32)
     m1 = unwrap(ctx.input("Moment1"))
     m2 = unwrap(ctx.input("Moment2"))
     b1p = unwrap(ctx.input("Beta1Pow")).reshape(())
@@ -58,9 +87,26 @@ def _adam(ctx):
     b2 = ctx.attr("beta2", 0.999)
     eps = ctx.attr("epsilon", 1e-8)
     lr = _lr(ctx)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    graw = ctx.input("Grad")
+    if is_sparse_grad(graw):
+        # Lazy Adam over SelectedRows: only touched rows advance their
+        # moments (duplicates merged first).
+        def upd(p_rows, g_rows, m1_rows, m2_rows):
+            g32 = g_rows.astype(jnp.float32)
+            m1n = b1 * m1_rows + (1 - b1) * g32
+            m2n = b2 * m2_rows + (1 - b2) * jnp.square(g32)
+            pn = p_rows.astype(jnp.float32) - lr_t * m1n / (jnp.sqrt(m2n) + eps)
+            return pn, m1n, m2n
+
+        p_new, m1n, m2n = rowwise_update(p, graw, upd, m1, m2)
+        ctx.set_output("ParamOut", p_new)
+        ctx.set_output("Moment1Out", m1n)
+        ctx.set_output("Moment2Out", m2n)
+        return
+    g = unwrap(graw).astype(jnp.float32)
     m1n = b1 * m1 + (1 - b1) * g
     m2n = b2 * m2 + (1 - b2) * jnp.square(g)
-    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
     p_new = p.astype(jnp.float32) - lr_t * m1n / (jnp.sqrt(m2n) + eps)
     ctx.set_output("ParamOut", p_new.astype(p.dtype))
     ctx.set_output("Moment1Out", m1n)
@@ -91,12 +137,29 @@ def _adamax(ctx):
 @register_op("adagrad", inputs=("Param", "Grad", "Moment", "LearningRate"),
              outputs=("ParamOut", "MomentOut"), stop_gradient=True)
 def _adagrad(ctx):
+    from paddle_tpu.sparse import is_sparse_grad, rowwise_update
+
     p = unwrap(ctx.input("Param"))
-    g = unwrap(ctx.input("Grad")).astype(jnp.float32)
     m = unwrap(ctx.input("Moment"))
     eps = ctx.attr("epsilon", 1e-6)
+    lr = _lr(ctx)
+    graw = ctx.input("Grad")
+    if is_sparse_grad(graw):
+        # SelectedRows branch (reference: operators/adagrad_op.cc):
+        # duplicate rows are merged before the non-linear update.
+        def upd(p_rows, g_rows, m_rows):
+            g32 = g_rows.astype(jnp.float32)
+            m_new = m_rows + jnp.square(g32)
+            return (p_rows.astype(jnp.float32)
+                    - lr * g32 / (jnp.sqrt(m_new) + eps)), m_new
+
+        p_new, m_new = rowwise_update(p, graw, upd, m)
+        ctx.set_output("ParamOut", p_new)
+        ctx.set_output("MomentOut", m_new)
+        return
+    g = unwrap(graw).astype(jnp.float32)
     m_new = m + jnp.square(g)
-    p_new = p.astype(jnp.float32) - _lr(ctx) * g / (jnp.sqrt(m_new) + eps)
+    p_new = p.astype(jnp.float32) - lr * g / (jnp.sqrt(m_new) + eps)
     ctx.set_output("ParamOut", p_new.astype(p.dtype))
     ctx.set_output("MomentOut", m_new)
 

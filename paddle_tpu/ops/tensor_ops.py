@@ -141,11 +141,40 @@ def _scatter(ctx):
     ctx.set_output("Out", ref.at[idx].set(upd))
 
 
-@register_op("lookup_table", inputs=("W", "Ids"), diff_inputs=("W",))
+def _lookup_table_grad_lower(ctx):
+    """W@GRAD for lookup_table (reference: operators/lookup_table_op.cc
+    LookupTableGradKernel).  With ``is_sparse`` the cotangent is kept as
+    a static-shape SelectedRows (`paddle_tpu.sparse.SparseGrad`) — the
+    (N, D) looked-up rows plus their indices — so no (vocab, D) dense
+    gradient is ever built; otherwise a dense scatter-add."""
+    from paddle_tpu.sparse import SparseGrad
+
+    gname = ctx.op.outputs.get("W@GRAD", [""])[0]
+    if not gname:
+        return
+    fwd_inputs = ctx.op.attr("__fwd_inputs__")
+    fwd_attrs = ctx.op.attr("__fwd_attrs__")
+    w = unwrap(ctx.values[fwd_inputs["W"][0]])
+    ids_data = unwrap(ctx.values[fwd_inputs["Ids"][0]]).astype(jnp.int32)
+    flat = ids_data[..., 0] if ids_data.shape[-1] == 1 else ids_data
+    g = unwrap(ctx.input("Out@GRAD"))
+    rows = flat.reshape(-1)
+    vals = g.reshape(-1, g.shape[-1])
+    padding_idx = fwd_attrs.get("padding_idx")
+    if padding_idx is not None and padding_idx >= 0:
+        vals = vals * (rows != padding_idx)[:, None].astype(vals.dtype)
+    if fwd_attrs.get("is_sparse"):
+        ctx.values[gname] = SparseGrad(rows, vals, w.shape[0])
+    else:
+        ctx.values[gname] = jnp.zeros_like(w).at[rows].add(vals.astype(w.dtype))
+
+
+@register_op("lookup_table", inputs=("W", "Ids"), diff_inputs=("W",),
+             grad_lower=_lookup_table_grad_lower)
 def _lookup_table(ctx):
     """Embedding lookup (reference: operators/lookup_table_op.cc).  Ids of
-    shape (..., 1) int64; gradient w.r.t. W is a dense scatter-add (the
-    reference's SelectedRows sparse path maps to XLA scatter on TPU)."""
+    shape (..., 1) int64; gradient w.r.t. W is a SelectedRows-style
+    (rows, values) pair when ``is_sparse`` else a dense scatter-add."""
     w = unwrap(ctx.input("W"))
     ids = ctx.input("Ids")
     ids_data = unwrap(ids).astype(jnp.int32)

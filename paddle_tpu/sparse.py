@@ -1,0 +1,122 @@
+"""SelectedRows, TPU-style: static-shape sparse row gradients.
+
+The reference carries embedding/sparse gradients as ``SelectedRows`` —
+a dynamically sized (rows, value) pair over a notional dense height
+(reference: paddle/framework/selected_rows.h:19, design
+paddle/framework/selected_rows.md) — produced by ``lookup_table_grad``
+(reference: paddle/operators/lookup_table_op.cc) and consumed row-wise
+by the sparse branches of ``sgd``/``adagrad`` (reference:
+paddle/operators/sgd_op.cc, adagrad_op.cc) and by the legacy
+``SparseRowMatrix`` lazy-update machinery (reference:
+paddle/math/SparseRowMatrix.h, parameter/FirstOrderOptimizer.h).
+
+A static-shape compiler wants fixed buffer sizes, so the TPU encoding
+is: ``rows`` is the *un-deduplicated* int32 id vector of length N
+(N = number of lookups in the batch — static under jit) and ``values``
+is the matching (N, D) cotangent rows.  Duplicate row merging
+(``SelectedRows`` "merge_dup_rows") is done inside the consumer with
+``jnp.unique(size=N)`` + ``segment_sum`` — fully jittable, no dense
+(height, D) gradient ever materialises, and optimizer updates touch
+only the N looked-up rows of the (height, D) parameter via XLA
+scatter.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class SparseGrad:
+    """Static-shape SelectedRows gradient: ``rows`` (N,) int32 indices
+    into a dense (height, D) tensor, ``values`` (N, D) rows.  Rows may
+    repeat; semantically the gradient is the scatter-add of ``values``
+    at ``rows``.  ``height`` is static metadata (the dense row count)."""
+
+    def __init__(self, rows, values, height: int):
+        self.rows = rows
+        self.values = values
+        self.height = int(height)
+
+    def tree_flatten(self):
+        return (self.rows, self.values), self.height
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        rows, values = children
+        return cls(rows, values, aux)
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def to_dense(self):
+        """Densify: scatter-add values at rows (duplicates accumulate)."""
+        dense = jnp.zeros(self.shape, self.values.dtype)
+        return dense.at[self.rows].add(self.values, mode="drop")
+
+    def merged(self):
+        """Deduplicate rows (SelectedRows ``merge_dup_rows`` analog).
+
+        Returns ``(urows, uvalues)`` of the same static length N; slots
+        beyond the number of distinct rows are filled with the
+        out-of-bounds index ``height`` so downstream ``.at[...]`` with
+        ``mode='drop'`` ignores them.
+        """
+        n = self.rows.shape[0]
+        urows, inv = jnp.unique(
+            self.rows, size=n, fill_value=self.height, return_inverse=True
+        )
+        uvalues = jax.ops.segment_sum(self.values, inv.reshape(-1), num_segments=n)
+        return urows, uvalues
+
+    def __repr__(self):
+        return f"SparseGrad(rows={self.rows.shape}, values={self.values.shape}, height={self.height})"
+
+
+def is_sparse_grad(x) -> bool:
+    return isinstance(x, SparseGrad)
+
+
+def concat_sparse(grads) -> SparseGrad:
+    """Sum of SelectedRows = row-wise concatenation (reference:
+    operators/sum_op.h SelectedRows branch)."""
+    height = grads[0].height
+    rows = jnp.concatenate([g.rows for g in grads])
+    values = jnp.concatenate([g.values for g in grads])
+    return SparseGrad(rows, values, height)
+
+
+def rowwise_update(param, sparse_grad: SparseGrad, update_rows, *states):
+    """Apply ``update_rows(p_rows, g_rows, *state_rows) -> (p_rows_new,
+    *state_rows_new)`` to the distinct touched rows only.
+
+    ``states`` are dense (height, ...) optimizer-state tensors updated
+    row-wise alongside the parameter (the legacy rowwise "lazy
+    catch-up" — reference: parameter/FirstOrderOptimizer.h sparse
+    variants — collapses to this under a compiled step, since rows are
+    updated exactly when touched).
+
+    Returns ``(param_new, *states_new)``.
+    """
+    urows, uvalues = sparse_grad.merged()
+    safe = jnp.minimum(urows, sparse_grad.height - 1)
+    p_rows = param[safe]
+    state_rows = [s[safe] for s in states]
+    # Gradients stay at their native (float32 cotangent) dtype so the
+    # optimizer's float32 math matches the dense branch bit-for-bit
+    # even when the parameter itself is bf16.
+    out = update_rows(p_rows, uvalues, *state_rows)
+    if not isinstance(out, tuple):
+        out = (out,)
+    p_new = param.at[urows].set(out[0].astype(param.dtype), mode="drop")
+    states_new = [
+        s.at[urows].set(o.astype(s.dtype), mode="drop")
+        for s, o in zip(states, out[1:])
+    ]
+    return (p_new, *states_new)

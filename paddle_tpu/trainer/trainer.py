@@ -35,11 +35,20 @@ class Trainer:
         settings = dict(conf.opt_config or {})
         lr = settings.get("learning_rate", 1e-3)
         method = settings.get("learning_method")
-        optimizer = (method.to_optimizer(lr) if method is not None else None)
+        opt_kwargs = {}
+        thr = settings.get("gradient_clipping_threshold")
+        if thr:
+            from paddle_tpu.clip import GradientClipByGlobalNorm
+
+            opt_kwargs["grad_clip"] = GradientClipByGlobalNorm(thr)
+        if settings.get("regularization") is not None:
+            opt_kwargs["regularization"] = settings["regularization"]
+        optimizer = (method.to_optimizer(lr, **opt_kwargs)
+                     if method is not None else None)
         if optimizer is None:
             from paddle_tpu import optimizer as opt
 
-            optimizer = opt.SGD(learning_rate=lr)
+            optimizer = opt.SGD(learning_rate=lr, **opt_kwargs)
         self.batch_size = settings.get("batch_size", 32)
         topo = Topology(conf.cost, extra_layers=conf.evaluators)
         params = v2_params.Parameters(topo)

@@ -423,6 +423,11 @@ class mixed_layer:
         return self
 
     def _add(self, proj):
+        if isinstance(proj, mixed_layer):  # finalized context-managed mixed
+            if proj._lo is None:
+                raise ValueError(
+                    "mixed_layer used as input before its 'with' block closed")
+            proj = proj._lo
         if isinstance(proj, LayerOutput):  # bare layer = identity proj
             proj = identity_projection(proj)
         self._projs.append(proj)

@@ -7,21 +7,36 @@ from __future__ import annotations
 __all__ = [
     "settings", "BaseSGDOptimizer", "MomentumOptimizer", "AdamOptimizer",
     "AdamaxOptimizer", "AdaGradOptimizer", "DecayedAdaGradOptimizer",
-    "AdaDeltaOptimizer", "RMSPropOptimizer",
+    "AdaDeltaOptimizer", "RMSPropOptimizer", "L1Regularization",
+    "L2Regularization",
 ]
 
 # the active config capture lives in layers.py
 from paddle_tpu.trainer_config_helpers import layers as _layers
 
 
+def L2Regularization(rate: float):
+    """settings(regularization=L2Regularization(rate)) (reference:
+    parameter/Regularizer.h L2Regularizer; decay applied per update)."""
+    from paddle_tpu.regularizer import L2DecayRegularizer
+
+    return L2DecayRegularizer(regularization_coeff=rate)
+
+
+def L1Regularization(rate: float):
+    from paddle_tpu.regularizer import L1DecayRegularizer
+
+    return L1DecayRegularizer(regularization_coeff=rate)
+
+
 class BaseSGDOptimizer:
     name = "sgd"
     extra = {}
 
-    def to_optimizer(self, learning_rate):
+    def to_optimizer(self, learning_rate, **kwargs):
         from paddle_tpu import optimizer as opt
 
-        return opt.SGD(learning_rate=learning_rate)
+        return opt.SGD(learning_rate=learning_rate, **kwargs)
 
 
 class MomentumOptimizer(BaseSGDOptimizer):
@@ -30,11 +45,11 @@ class MomentumOptimizer(BaseSGDOptimizer):
     def __init__(self, momentum: float = 0.9, sparse: bool = False):
         self.momentum = momentum
 
-    def to_optimizer(self, learning_rate):
+    def to_optimizer(self, learning_rate, **kwargs):
         from paddle_tpu import optimizer as opt
 
         return opt.Momentum(learning_rate=learning_rate,
-                            momentum=self.momentum)
+                            momentum=self.momentum, **kwargs)
 
 
 class AdamOptimizer(BaseSGDOptimizer):
@@ -44,11 +59,11 @@ class AdamOptimizer(BaseSGDOptimizer):
                  epsilon: float = 1e-8):
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
 
-    def to_optimizer(self, learning_rate):
+    def to_optimizer(self, learning_rate, **kwargs):
         from paddle_tpu import optimizer as opt
 
         return opt.Adam(learning_rate=learning_rate, beta1=self.beta1,
-                        beta2=self.beta2, epsilon=self.epsilon)
+                        beta2=self.beta2, epsilon=self.epsilon, **kwargs)
 
 
 class AdamaxOptimizer(BaseSGDOptimizer):
@@ -57,20 +72,20 @@ class AdamaxOptimizer(BaseSGDOptimizer):
     def __init__(self, beta1: float = 0.9, beta2: float = 0.999):
         self.beta1, self.beta2 = beta1, beta2
 
-    def to_optimizer(self, learning_rate):
+    def to_optimizer(self, learning_rate, **kwargs):
         from paddle_tpu import optimizer as opt
 
         return opt.Adamax(learning_rate=learning_rate, beta1=self.beta1,
-                          beta2=self.beta2)
+                          beta2=self.beta2, **kwargs)
 
 
 class AdaGradOptimizer(BaseSGDOptimizer):
     name = "adagrad"
 
-    def to_optimizer(self, learning_rate):
+    def to_optimizer(self, learning_rate, **kwargs):
         from paddle_tpu import optimizer as opt
 
-        return opt.Adagrad(learning_rate=learning_rate)
+        return opt.Adagrad(learning_rate=learning_rate, **kwargs)
 
 
 class DecayedAdaGradOptimizer(BaseSGDOptimizer):
@@ -79,11 +94,11 @@ class DecayedAdaGradOptimizer(BaseSGDOptimizer):
     def __init__(self, rho: float = 0.95, epsilon: float = 1e-6):
         self.rho, self.epsilon = rho, epsilon
 
-    def to_optimizer(self, learning_rate):
+    def to_optimizer(self, learning_rate, **kwargs):
         from paddle_tpu import optimizer as opt
 
         return opt.DecayedAdagrad(learning_rate=learning_rate,
-                                  decay=self.rho, epsilon=self.epsilon)
+                                  decay=self.rho, epsilon=self.epsilon, **kwargs)
 
 
 class AdaDeltaOptimizer(BaseSGDOptimizer):
@@ -92,11 +107,11 @@ class AdaDeltaOptimizer(BaseSGDOptimizer):
     def __init__(self, rho: float = 0.95, epsilon: float = 1e-6):
         self.rho, self.epsilon = rho, epsilon
 
-    def to_optimizer(self, learning_rate):
+    def to_optimizer(self, learning_rate, **kwargs):
         from paddle_tpu import optimizer as opt
 
         return opt.Adadelta(learning_rate=learning_rate, rho=self.rho,
-                            epsilon=self.epsilon)
+                            epsilon=self.epsilon, **kwargs)
 
 
 class RMSPropOptimizer(BaseSGDOptimizer):
@@ -105,11 +120,11 @@ class RMSPropOptimizer(BaseSGDOptimizer):
     def __init__(self, rho: float = 0.95, epsilon: float = 1e-6):
         self.rho, self.epsilon = rho, epsilon
 
-    def to_optimizer(self, learning_rate):
+    def to_optimizer(self, learning_rate, **kwargs):
         from paddle_tpu import optimizer as opt
 
         return opt.RMSProp(learning_rate=learning_rate, rho=self.rho,
-                           epsilon=self.epsilon)
+                           epsilon=self.epsilon, **kwargs)
 
 
 def settings(batch_size: int = 32, learning_rate: float = 1e-3,

@@ -144,7 +144,13 @@ def embedding(input, size: int, param_attr=None, name=None, **kwargs):
             helper.append_op(type="reshape", inputs={"X": [idv]},
                              outputs={"Out": [r]}, attrs={"shape": [0, -1, 1]})
             idv = r
-        emb = L.embedding(input=idv, size=[vocab, size], param_attr=param_attr)
+        # v1's ParameterAttribute(sparse_update=True) selects the
+        # SelectedRows sparse-gradient path (reference:
+        # trainer/RemoteParameterUpdater.h:265 sparse_remote_update).
+        is_sparse = kwargs.get(
+            "is_sparse", bool(getattr(param_attr, "sparse_update", False)))
+        emb = L.embedding(input=idv, size=[vocab, size], param_attr=param_attr,
+                          is_sparse=is_sparse)
         return SeqVal(emb, ids.lengths) if seq else emb
 
     return LayerOutput(name or _uname("embedding"), [input], build, size=size,

@@ -82,30 +82,8 @@ class AdaDelta(Optimizer):
         super().__init__(**kwargs)
 
     def _make_core(self, lr, **kwargs):
-        # core adadelta has no lr input; emulate via plain optimizer
-        class _AdaDelta(core_opt.Optimizer):
-            def __init__(s, lr_, rho, eps, **kw):
-                super().__init__(lr_, **kw)
-                s._rho, s._eps = rho, eps
-
-            def _create_accumulators(s, block, params):
-                for p in params:
-                    s._add_accumulator("avg_sq_grad", p)
-                    s._add_accumulator("avg_sq_update", p)
-
-            def _append_optimize_op(s, block, pg):
-                p, g = pg
-                return block.append_op(
-                    type="adadelta",
-                    inputs={"Param": [p], "Grad": [g],
-                            "AvgSquaredGrad": [s._get_accumulator("avg_sq_grad", p)],
-                            "AvgSquaredUpdate": [s._get_accumulator("avg_sq_update", p)]},
-                    outputs={"ParamOut": [p],
-                             "AvgSquaredGradOut": [s._get_accumulator("avg_sq_grad", p)],
-                             "AvgSquaredUpdateOut": [s._get_accumulator("avg_sq_update", p)]},
-                    attrs={"rho": s._rho, "epsilon": s._eps})
-
-        return _AdaDelta(lr, self._rho, self._eps, **kwargs)
+        return core_opt.AdadeltaOptimizer(lr, rho=self._rho,
+                                          epsilon=self._eps, **kwargs)
 
 
 class RMSProp(Optimizer):

@@ -1,0 +1,139 @@
+"""SelectedRows sparse-gradient path (reference model:
+paddle/framework/selected_rows.h, operators/lookup_table_op.cc sparse
+grad, operators/sgd_op.cc + adagrad_op.cc SelectedRows kernels,
+python/paddle/v2/fluid/tests/test_sgd_op.py TestSparseSGDOp).
+
+The sparse and dense paths must produce identical parameters; the
+sparse path just never materialises the (vocab, dim) dense gradient.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _embedding_step(rng, is_sparse, optimizer, ids, vocab=60, dim=8, steps=1):
+    """Build embedding -> fc -> softmax CE, run `steps` batches, return
+    the embedding table."""
+    from paddle_tpu import framework
+
+    framework.reset_default_programs()
+    w = fluid.layers.data(name="w", shape=[1], dtype="int64")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    emb = fluid.layers.embedding(
+        w, size=[vocab, dim], is_sparse=is_sparse,
+        param_attr=fluid.ParamAttr(name="emb_w"))
+    pred = fluid.layers.fc(input=emb, size=10, act="softmax",
+                           param_attr=fluid.ParamAttr(name="fc_w"))
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(input=pred, label=label))
+    optimizer().minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    # Deterministic init across the two builds.
+    scope = fluid.global_scope()
+    init_rng = np.random.RandomState(7)
+    for name in ("emb_w", "fc_w"):
+        var = scope.find_var(name)
+        var.set(init_rng.randn(*np.asarray(var.get_tensor()).shape).astype("float32"))
+    labels = np.random.RandomState(3).randint(0, 10, (steps, ids.shape[0]))
+    for s in range(steps):
+        exe.run(feed={"w": ids.reshape(-1, 1),
+                      "label": labels[s].reshape(-1, 1).astype("int64")},
+                fetch_list=[loss])
+    return np.asarray(scope.find_var("emb_w").get_tensor())
+
+
+@pytest.mark.parametrize("opt", ["sgd", "momentum", "adagrad", "adam"])
+def test_sparse_matches_dense(rng, opt):
+    makers = {
+        "sgd": lambda: fluid.optimizer.SGD(learning_rate=0.1),
+        "momentum": lambda: fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9),
+        "adagrad": lambda: fluid.optimizer.Adagrad(learning_rate=0.1),
+        "adam": lambda: fluid.optimizer.Adam(learning_rate=0.1),
+    }
+    # Duplicate ids in the batch: exercises merge_dup_rows semantics.
+    ids = np.array([3, 7, 3, 11, 7, 3, 0, 59], dtype="int64")
+    dense = _embedding_step(rng, False, makers[opt], ids, steps=3)
+    sparse = _embedding_step(rng, True, makers[opt], ids, steps=3)
+    np.testing.assert_allclose(sparse, dense, rtol=1e-5, atol=1e-5)
+
+
+def test_sparse_update_is_rowwise_lazy():
+    """Untouched rows must not move even under Adam (lazy semantics —
+    reference legacy rowwise catch-up collapses to touch-time updates)."""
+    ids = np.array([1, 2, 1], dtype="int64")
+    before = np.random.RandomState(7).randn(60, 8).astype("float32")
+    after = _embedding_step(np.random, True,
+                            lambda: fluid.optimizer.Adam(learning_rate=0.1),
+                            ids, steps=1)
+    touched = {1, 2}
+    for r in range(60):
+        if r in touched:
+            assert not np.allclose(after[r], before[r]), r
+        else:
+            np.testing.assert_array_equal(after[r], before[r])
+
+
+def test_shared_embedding_sum_stays_sparse(rng):
+    """Two lookups into one table: append_backward dedups W@GRAD with a
+    sum op whose SelectedRows branch concatenates rows."""
+    from paddle_tpu import framework
+
+    vocab, dim = 40, 6
+
+    def run(is_sparse):
+        framework.reset_default_programs()
+        a = fluid.layers.data(name="a", shape=[1], dtype="int64")
+        b = fluid.layers.data(name="b", shape=[1], dtype="int64")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        ea = fluid.layers.embedding(a, size=[vocab, dim], is_sparse=is_sparse,
+                                    param_attr=fluid.ParamAttr(name="shared_w"))
+        eb = fluid.layers.embedding(b, size=[vocab, dim], is_sparse=is_sparse,
+                                    param_attr=fluid.ParamAttr(name="shared_w"))
+        h = fluid.layers.elementwise_add(x=ea, y=eb)
+        pred = fluid.layers.fc(input=h, size=5, act="softmax",
+                               param_attr=fluid.ParamAttr(name="fc_shared"))
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        scope = fluid.global_scope()
+        init_rng = np.random.RandomState(11)
+        for name in ("shared_w", "fc_shared"):
+            var = scope.find_var(name)
+            var.set(init_rng.randn(*np.asarray(var.get_tensor()).shape).astype("float32"))
+        ids_a = np.array([[4], [9], [4]], dtype="int64")
+        ids_b = np.array([[9], [2], [30]], dtype="int64")
+        ys = np.array([[0], [3], [1]], dtype="int64")
+        exe.run(feed={"a": ids_a, "b": ids_b, "label": ys}, fetch_list=[loss])
+        return np.asarray(scope.find_var("shared_w").get_tensor())
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_grad_object():
+    """Unit semantics of the SparseGrad container itself."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.sparse import SparseGrad, concat_sparse
+
+    rows = jnp.array([2, 5, 2], dtype=jnp.int32)
+    vals = jnp.array([[1.0, 2.0], [3.0, 4.0], [10.0, 20.0]])
+    g = SparseGrad(rows, vals, height=8)
+    dense = np.zeros((8, 2), np.float32)
+    dense[2] = [11.0, 22.0]
+    dense[5] = [3.0, 4.0]
+    np.testing.assert_allclose(np.asarray(g.to_dense()), dense)
+
+    urows, uvals = g.merged()
+    got = np.zeros((8, 2), np.float32)
+    for r, v in zip(np.asarray(urows), np.asarray(uvals)):
+        if r < 8:
+            got[r] += v
+    np.testing.assert_allclose(got, dense)
+
+    cat = concat_sparse([g, g])
+    np.testing.assert_allclose(np.asarray(cat.to_dense()), 2 * dense)
