@@ -1,0 +1,370 @@
+// Coordination store: the etcd-equivalent for the distributed runtime.
+//
+// The reference leaned on an external etcd for everything the cluster
+// had to agree on: master election + address publication
+// (go/master/etcd_client.go), pserver index claims via STM transactions
+// (go/pserver/etcd_client.go:170 registerPserverEtcd), TTL lease
+// keepalives, and checkpoint metadata (go/pserver/service.go:270-283).
+// A TPU-era rebuild keeps that control plane on DCN but shouldn't
+// require an external etcd binary, so this is a small single-node
+// coordination service with the subset of etcd semantics the runtime
+// actually uses:
+//   - KV: GET/PUT/DEL (PUT optionally bound to a lease)
+//   - Compare-and-swap: CAS key old new  (empty old = "create if
+//     absent") — enough to express the STM index-claim loop
+//   - Leases: LEASE <ttl_sec> -> id; KEEPALIVE <id>; expired leases
+//     delete their keys (background sweeper)
+//   - Watch-by-poll: WAIT <key> <last_rev> blocks until the key's
+//     revision exceeds last_rev (or timeout) — clients poll-watch the
+//     master address exactly like go/master/client.go:186 monitorMaster
+//
+// Wire protocol: newline-delimited text, values hex-encoded so they
+// can carry arbitrary bytes.
+//   PING                        -> PONG
+//   PUT <key> <hexval> [lease]  -> OK <rev>
+//   GET <key>                   -> VAL <rev> <hexval> | NONE
+//   DEL <key>                   -> OK
+//   CAS <key> <hexold|-> <hexnew> [lease] -> OK <rev> | FAIL
+//   LEASE <ttl_sec>             -> LEASE <id>
+//   KEEPALIVE <id>              -> OK | ERR expired
+//   REVOKE <id>                 -> OK
+//   WAIT <key> <rev> <ms>       -> VAL <rev> <hexval> | NONE | TIMEOUT
+//   SHUTDOWN                    -> OK
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <set>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Entry {
+  std::string value;
+  int64_t rev = 0;
+  int64_t lease = 0;  // 0 = no lease
+};
+
+struct Lease {
+  Clock::time_point deadline;
+  int ttl_sec;
+  std::set<std::string> keys;
+};
+
+struct Store {
+  int port = 0;
+  int listen_fd = -1;
+  std::atomic<bool> stop{false};
+  std::mutex mu;
+  std::condition_variable cv;  // signaled on any mutation
+  std::map<std::string, Entry> kv;
+  std::map<int64_t, Lease> leases;
+  int64_t next_rev = 1;
+  int64_t next_lease = 1;
+  std::thread accept_thread;
+  std::thread sweep_thread;
+  std::vector<std::thread> conns;
+  std::set<int> live_fds;  // force-shutdown on stop so joins can't hang
+  std::mutex conns_mu;
+
+  // mu held
+  void Expire(Clock::time_point now) {
+    for (auto it = leases.begin(); it != leases.end();) {
+      if (it->second.deadline <= now) {
+        for (const auto& k : it->second.keys) {
+          auto e = kv.find(k);
+          if (e != kv.end() && e->second.lease == it->first) kv.erase(e);
+        }
+        it = leases.erase(it);
+        cv.notify_all();
+      } else {
+        ++it;
+      }
+    }
+  }
+};
+
+std::string Hex(const std::string& s) {
+  static const char* d = "0123456789abcdef";
+  std::string out;
+  out.reserve(s.size() * 2);
+  for (unsigned char c : s) {
+    out.push_back(d[c >> 4]);
+    out.push_back(d[c & 15]);
+  }
+  return out.empty() ? "-" : out;
+}
+
+int Nibble(char c) {
+  if (c >= '0' && c <= '9') return c - '0';
+  if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+  if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+  return -1;
+}
+
+bool Unhex(const std::string& h, std::string* out) {
+  out->clear();
+  if (h == "-") return true;
+  if (h.size() % 2) return false;
+  out->reserve(h.size() / 2);
+  for (size_t i = 0; i < h.size(); i += 2) {
+    int hi = Nibble(h[i]), lo = Nibble(h[i + 1]);
+    if (hi < 0 || lo < 0) return false;
+    out->push_back(static_cast<char>((hi << 4) | lo));
+  }
+  return true;
+}
+
+bool ReadLine(int fd, std::string* line) {
+  line->clear();
+  char c;
+  while (true) {
+    ssize_t n = recv(fd, &c, 1, 0);
+    if (n <= 0) return false;
+    if (c == '\n') return true;
+    line->push_back(c);
+    if (line->size() > 1 << 20) return false;
+  }
+}
+
+bool Reply(int fd, const std::string& s) {
+  const char* p = s.data();
+  size_t n = s.size();
+  while (n > 0) {
+    ssize_t r = send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+void ServeConn(Store* st, int fd) {
+  std::string line;
+  while (!st->stop.load() && ReadLine(fd, &line)) {
+    std::istringstream in(line);
+    std::string cmd;
+    in >> cmd;
+    std::ostringstream out;
+    if (cmd == "PING") {
+      out << "PONG\n";
+    } else if (cmd == "PUT") {
+      std::string key, hexval;
+      int64_t lease = 0;
+      in >> key >> hexval >> lease;
+      std::string val;
+      if (!Unhex(hexval, &val)) {
+        out << "ERR bad hex\n";
+      } else {
+        std::lock_guard<std::mutex> l(st->mu);
+        st->Expire(Clock::now());
+        if (lease && !st->leases.count(lease)) {
+          out << "ERR expired lease\n";
+        } else {
+          Entry& e = st->kv[key];
+          e.value = val;
+          e.rev = st->next_rev++;
+          e.lease = lease;
+          if (lease) st->leases[lease].keys.insert(key);
+          st->cv.notify_all();
+          out << "OK " << e.rev << "\n";
+        }
+      }
+    } else if (cmd == "GET") {
+      std::string key;
+      in >> key;
+      std::lock_guard<std::mutex> l(st->mu);
+      st->Expire(Clock::now());
+      auto it = st->kv.find(key);
+      if (it == st->kv.end()) out << "NONE\n";
+      else out << "VAL " << it->second.rev << " " << Hex(it->second.value) << "\n";
+    } else if (cmd == "DEL") {
+      std::string key;
+      in >> key;
+      std::lock_guard<std::mutex> l(st->mu);
+      st->kv.erase(key);
+      st->cv.notify_all();
+      out << "OK\n";
+    } else if (cmd == "CAS") {
+      std::string key, hexold, hexnew;
+      int64_t lease = 0;
+      in >> key >> hexold >> hexnew >> lease;
+      std::string oldv, newv;
+      if (!Unhex(hexold, &oldv) || !Unhex(hexnew, &newv)) {
+        out << "ERR bad hex\n";
+      } else {
+        std::lock_guard<std::mutex> l(st->mu);
+        st->Expire(Clock::now());
+        auto it = st->kv.find(key);
+        bool match = (hexold == "-") ? it == st->kv.end()
+                                     : (it != st->kv.end() && it->second.value == oldv);
+        if (!match) {
+          out << "FAIL\n";
+        } else if (lease && !st->leases.count(lease)) {
+          out << "ERR expired lease\n";
+        } else {
+          Entry& e = st->kv[key];
+          e.value = newv;
+          e.rev = st->next_rev++;
+          e.lease = lease;
+          if (lease) st->leases[lease].keys.insert(key);
+          st->cv.notify_all();
+          out << "OK " << e.rev << "\n";
+        }
+      }
+    } else if (cmd == "LEASE") {
+      int ttl = 0;
+      in >> ttl;
+      std::lock_guard<std::mutex> l(st->mu);
+      int64_t id = st->next_lease++;
+      st->leases[id] = Lease{Clock::now() + std::chrono::seconds(ttl), ttl, {}};
+      out << "LEASE " << id << "\n";
+    } else if (cmd == "KEEPALIVE") {
+      int64_t id = 0;
+      in >> id;
+      std::lock_guard<std::mutex> l(st->mu);
+      st->Expire(Clock::now());
+      auto it = st->leases.find(id);
+      if (it == st->leases.end()) {
+        out << "ERR expired\n";
+      } else {
+        it->second.deadline = Clock::now() + std::chrono::seconds(it->second.ttl_sec);
+        out << "OK\n";
+      }
+    } else if (cmd == "REVOKE") {
+      int64_t id = 0;
+      in >> id;
+      std::lock_guard<std::mutex> l(st->mu);
+      auto it = st->leases.find(id);
+      if (it != st->leases.end()) {
+        it->second.deadline = Clock::now();
+        st->Expire(Clock::now());
+      }
+      out << "OK\n";
+    } else if (cmd == "WAIT") {
+      std::string key;
+      int64_t rev = 0;
+      long ms = 0;
+      in >> key >> rev >> ms;
+      std::unique_lock<std::mutex> l(st->mu);
+      auto deadline = Clock::now() + std::chrono::milliseconds(ms);
+      bool changed = st->cv.wait_until(l, deadline, [&] {
+        if (st->stop.load()) return true;
+        st->Expire(Clock::now());
+        auto it = st->kv.find(key);
+        // fire on: key now exists with newer rev, or key deleted while
+        // the caller saw rev>0
+        if (it == st->kv.end()) return rev > 0;
+        return it->second.rev > rev;
+      });
+      if (!changed) {
+        out << "TIMEOUT\n";
+      } else {
+        auto it = st->kv.find(key);
+        if (it == st->kv.end()) out << "NONE\n";
+        else out << "VAL " << it->second.rev << " " << Hex(it->second.value) << "\n";
+      }
+    } else if (cmd == "SHUTDOWN") {
+      Reply(fd, "OK\n");
+      st->stop.store(true);
+      break;
+    } else {
+      out << "ERR bad command\n";
+    }
+    if (!Reply(fd, out.str())) break;
+  }
+  {
+    std::lock_guard<std::mutex> l(st->conns_mu);
+    st->live_fds.erase(fd);
+  }
+  close(fd);
+}
+
+void AcceptLoop(Store* st) {
+  while (!st->stop.load()) {
+    int fd = accept(st->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (st->stop.load()) break;
+      continue;
+    }
+    std::lock_guard<std::mutex> l(st->conns_mu);
+    st->live_fds.insert(fd);
+    st->conns.emplace_back([st, fd] { ServeConn(st, fd); });
+  }
+}
+
+void SweepLoop(Store* st) {
+  while (!st->stop.load()) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(200));
+    std::lock_guard<std::mutex> l(st->mu);
+    st->Expire(Clock::now());
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+Store* coord_start(int port) {
+  auto* st = new Store();
+  st->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (st->listen_fd < 0) { delete st; return nullptr; }
+  int one = 1;
+  setsockopt(st->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(st->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      listen(st->listen_fd, 64) < 0) {
+    close(st->listen_fd);
+    delete st;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(st->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  st->port = ntohs(addr.sin_port);
+  st->accept_thread = std::thread(AcceptLoop, st);
+  st->sweep_thread = std::thread(SweepLoop, st);
+  return st;
+}
+
+int coord_port(Store* st) { return st ? st->port : -1; }
+
+void coord_stop(Store* st) {
+  if (!st) return;
+  st->stop.store(true);
+  st->cv.notify_all();
+  shutdown(st->listen_fd, SHUT_RDWR);
+  close(st->listen_fd);
+  if (st->accept_thread.joinable()) st->accept_thread.join();
+  if (st->sweep_thread.joinable()) st->sweep_thread.join();
+  {
+    std::lock_guard<std::mutex> l(st->conns_mu);
+    for (int cfd : st->live_fds) shutdown(cfd, SHUT_RDWR);
+  }
+  // join OUTSIDE conns_mu: exiting conn threads take it to deregister
+  std::vector<std::thread> done;
+  {
+    std::lock_guard<std::mutex> l(st->conns_mu);
+    done.swap(st->conns);
+  }
+  for (auto& t : done) if (t.joinable()) t.join();
+  delete st;
+}
+
+}  // extern "C"

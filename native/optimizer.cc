@@ -1,0 +1,370 @@
+// Standalone optimizer library with a C ABI and state serialization.
+//
+// C++ rebuild of the reference's `paddle/optimizer` C library
+// (reference: paddle/optimizer/optimizer.h:62-103 —
+// paddle_create_optimizer / paddle_update_parameter /
+// paddle_optimizer_get_weights / paddle_optimizer_get_state), which the
+// Go pserver consumed through cgo to run per-parameter updates server
+// side.  Here the consumer is the C++ pserver service
+// (native/pserver_service.cc) and tests via ctypes.
+//
+// Config is a flat text string ("type=adam lr=0.001 beta1=0.9 ...")
+// instead of the reference's OptimizerConfig protobuf
+// (proto/OptimizerConfig.proto) — same knobs, no proto dependency.
+// Optimizers: sgd (+momentum, nesterov), adagrad, adadelta, adam
+// (reference: paddle/optimizer/sgd_optimizer.cc, adagrad_optimizer.cc,
+// adadelta_optimizer.cc, adam_optimizer.cc); LR policies: const and
+// linear decay (paddle/optimizer/lr_policy.h).
+//
+// Serialization: versioned binary blob of hyperparams + step + all
+// state buffers, CRC32-guarded by the checkpoint layer above
+// (reference: paddle/optimizer/serialization.h used
+// tensor-proto-per-buffer; same contract, simpler encoding).
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct OptConfig {
+  std::string type = "sgd";
+  double lr = 0.01;
+  double momentum = 0.0;
+  bool nesterov = false;
+  double decay = 0.0;          // L2 weight decay
+  double epsilon = 1e-6;
+  double rho = 0.95;           // adadelta
+  double beta1 = 0.9;          // adam
+  double beta2 = 0.999;        // adam
+  // lr policy: const | linear (lr_decay_a/lr_decay_b as in
+  // paddle/optimizer/lr_policy.h:51 — max(lr - a*step, b))
+  std::string lr_policy = "const";
+  double lr_decay_a = 0.0;
+  double lr_decay_b = 0.0;
+};
+
+OptConfig ParseConfig(const std::string& s) {
+  OptConfig c;
+  std::istringstream in(s);
+  std::string kv;
+  while (in >> kv) {
+    auto eq = kv.find('=');
+    if (eq == std::string::npos) continue;
+    std::string k = kv.substr(0, eq), v = kv.substr(eq + 1);
+    if (k == "type") c.type = v;
+    else if (k == "lr") c.lr = std::stod(v);
+    else if (k == "momentum") c.momentum = std::stod(v);
+    else if (k == "nesterov") c.nesterov = (v == "1" || v == "true");
+    else if (k == "decay") c.decay = std::stod(v);
+    else if (k == "epsilon") c.epsilon = std::stod(v);
+    else if (k == "rho") c.rho = std::stod(v);
+    else if (k == "beta1") c.beta1 = std::stod(v);
+    else if (k == "beta2") c.beta2 = std::stod(v);
+    else if (k == "lr_policy") c.lr_policy = v;
+    else if (k == "lr_decay_a") c.lr_decay_a = std::stod(v);
+    else if (k == "lr_decay_b") c.lr_decay_b = std::stod(v);
+  }
+  return c;
+}
+
+struct Optimizer {
+  OptConfig cfg;
+  std::string cfg_str;
+  int64_t step = 0;
+  std::vector<float> weights;
+  // named state buffers (momentums, accumulators, ...), all same length
+  // as weights.
+  std::map<std::string, std::vector<float>> state;
+
+  double LearningRate() const {
+    if (cfg.lr_policy == "linear") {
+      double lr = cfg.lr - cfg.lr_decay_a * static_cast<double>(step);
+      return lr > cfg.lr_decay_b ? lr : cfg.lr_decay_b;
+    }
+    return cfg.lr;
+  }
+
+  std::vector<float>& Buf(const std::string& name) {
+    auto it = state.find(name);
+    if (it == state.end()) {
+      it = state.emplace(name, std::vector<float>(weights.size(), 0.f)).first;
+    }
+    return it->second;
+  }
+
+  // Dense update over the full weight vector.
+  void Update(const float* grad, size_t n) {
+    UpdateRows(grad, nullptr, n == 0 ? 0 : 1, n);
+  }
+
+  // Row-wise update: applies the optimizer rule to `nrows` rows of
+  // `width` elements each; rows==nullptr means rows 0..nrows-1 (dense).
+  // This is the sparse-row path the C++ pserver used for
+  // sparse_remote_update (reference: paddle/math/SparseRowMatrix.h,
+  // pserver/ParameterServer2.h:468 async/sparse apply).
+  void UpdateRows(const float* grad, const int64_t* rows, size_t nrows,
+                  size_t width) {
+    ++step;
+    const double lr = LearningRate();
+    const float decay = static_cast<float>(cfg.decay);
+    if (cfg.type == "sgd") {
+      std::vector<float>* mom = cfg.momentum != 0.0 ? &Buf("momentum") : nullptr;
+      for (size_t r = 0; r < nrows; ++r) {
+        size_t row = rows ? static_cast<size_t>(rows[r]) : r;
+        float* w = weights.data() + row * width;
+        const float* g = grad + r * width;
+        for (size_t i = 0; i < width; ++i) {
+          float gi = g[i] + decay * w[i];
+          if (mom) {
+            float& m = (*mom)[row * width + i];
+            m = static_cast<float>(cfg.momentum) * m - static_cast<float>(lr) * gi;
+            w[i] += cfg.nesterov
+                        ? static_cast<float>(cfg.momentum) * m - static_cast<float>(lr) * gi
+                        : m;
+          } else {
+            w[i] -= static_cast<float>(lr) * gi;
+          }
+        }
+      }
+    } else if (cfg.type == "adagrad") {
+      auto& acc = Buf("accum");
+      for (size_t r = 0; r < nrows; ++r) {
+        size_t row = rows ? static_cast<size_t>(rows[r]) : r;
+        float* w = weights.data() + row * width;
+        const float* g = grad + r * width;
+        for (size_t i = 0; i < width; ++i) {
+          float gi = g[i] + decay * w[i];
+          float& a = acc[row * width + i];
+          a += gi * gi;
+          w[i] -= static_cast<float>(lr) * gi /
+                  (std::sqrt(a) + static_cast<float>(cfg.epsilon));
+        }
+      }
+    } else if (cfg.type == "adadelta") {
+      auto& ag = Buf("accum_g");
+      auto& ad = Buf("accum_d");
+      const float rho = static_cast<float>(cfg.rho);
+      const float eps = static_cast<float>(cfg.epsilon);
+      for (size_t r = 0; r < nrows; ++r) {
+        size_t row = rows ? static_cast<size_t>(rows[r]) : r;
+        float* w = weights.data() + row * width;
+        const float* g = grad + r * width;
+        for (size_t i = 0; i < width; ++i) {
+          float gi = g[i] + decay * w[i];
+          size_t k = row * width + i;
+          ag[k] = rho * ag[k] + (1 - rho) * gi * gi;
+          float dx = -std::sqrt((ad[k] + eps) / (ag[k] + eps)) * gi;
+          ad[k] = rho * ad[k] + (1 - rho) * dx * dx;
+          w[i] += static_cast<float>(lr) * dx;
+        }
+      }
+    } else if (cfg.type == "rmsprop") {
+      auto& ms = Buf("mean_square");
+      const float rho = static_cast<float>(cfg.rho);
+      const float eps = static_cast<float>(cfg.epsilon);
+      for (size_t r = 0; r < nrows; ++r) {
+        size_t row = rows ? static_cast<size_t>(rows[r]) : r;
+        float* w = weights.data() + row * width;
+        const float* g = grad + r * width;
+        for (size_t i = 0; i < width; ++i) {
+          float gi = g[i] + decay * w[i];
+          float& m = ms[row * width + i];
+          m = rho * m + (1 - rho) * gi * gi;
+          w[i] -= static_cast<float>(lr) * gi / (std::sqrt(m) + eps);
+        }
+      }
+    } else if (cfg.type == "decayed_adagrad") {
+      auto& acc = Buf("accum");
+      const float rho = static_cast<float>(cfg.rho);
+      for (size_t r = 0; r < nrows; ++r) {
+        size_t row = rows ? static_cast<size_t>(rows[r]) : r;
+        float* w = weights.data() + row * width;
+        const float* g = grad + r * width;
+        for (size_t i = 0; i < width; ++i) {
+          float gi = g[i] + decay * w[i];
+          float& a = acc[row * width + i];
+          a = rho * a + (1 - rho) * gi * gi;
+          w[i] -= static_cast<float>(lr) * gi /
+                  (std::sqrt(a) + static_cast<float>(cfg.epsilon));
+        }
+      }
+    } else if (cfg.type == "adamax") {
+      auto& m1 = Buf("m1");
+      auto& inf = Buf("inf_norm");
+      const float b1 = static_cast<float>(cfg.beta1);
+      const float b2 = static_cast<float>(cfg.beta2);
+      const double bc1 = 1.0 - std::pow(cfg.beta1, static_cast<double>(step));
+      const float alpha = static_cast<float>(lr / bc1);
+      for (size_t r = 0; r < nrows; ++r) {
+        size_t row = rows ? static_cast<size_t>(rows[r]) : r;
+        float* w = weights.data() + row * width;
+        const float* g = grad + r * width;
+        for (size_t i = 0; i < width; ++i) {
+          float gi = g[i] + decay * w[i];
+          size_t k = row * width + i;
+          m1[k] = b1 * m1[k] + (1 - b1) * gi;
+          inf[k] = std::max(b2 * inf[k], std::fabs(gi));
+          w[i] -= alpha * m1[k] / (inf[k] + static_cast<float>(cfg.epsilon));
+        }
+      }
+    } else {  // adam
+      auto& m1 = Buf("m1");
+      auto& m2 = Buf("m2");
+      const float b1 = static_cast<float>(cfg.beta1);
+      const float b2 = static_cast<float>(cfg.beta2);
+      const double bc1 = 1.0 - std::pow(cfg.beta1, static_cast<double>(step));
+      const double bc2 = 1.0 - std::pow(cfg.beta2, static_cast<double>(step));
+      const float alpha = static_cast<float>(lr * std::sqrt(bc2) / bc1);
+      for (size_t r = 0; r < nrows; ++r) {
+        size_t row = rows ? static_cast<size_t>(rows[r]) : r;
+        float* w = weights.data() + row * width;
+        const float* g = grad + r * width;
+        for (size_t i = 0; i < width; ++i) {
+          float gi = g[i] + decay * w[i];
+          size_t k = row * width + i;
+          m1[k] = b1 * m1[k] + (1 - b1) * gi;
+          m2[k] = b2 * m2[k] + (1 - b2) * gi * gi;
+          w[i] -= alpha * m1[k] /
+                  (std::sqrt(m2[k]) + static_cast<float>(cfg.epsilon));
+        }
+      }
+    }
+  }
+};
+
+void PutU64(std::string* out, uint64_t v) {
+  out->append(reinterpret_cast<const char*>(&v), 8);
+}
+void PutBytes(std::string* out, const void* p, size_t n) {
+  PutU64(out, n);
+  out->append(reinterpret_cast<const char*>(p), n);
+}
+bool GetU64(const uint8_t** p, const uint8_t* end, uint64_t* v) {
+  if (end - *p < 8) return false;
+  std::memcpy(v, *p, 8);
+  *p += 8;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Mirrors paddle_create_optimizer (reference optimizer/optimizer.h:75):
+// config + initial weights -> handle.  Unknown optimizer types are
+// rejected (nullptr) rather than silently mapped to a default.
+Optimizer* opt_create(const char* config, const float* weights, uint64_t n) {
+  auto* o = new Optimizer();
+  o->cfg_str = config ? config : "";
+  o->cfg = ParseConfig(o->cfg_str);
+  static const char* kKnown[] = {"sgd", "adagrad", "adadelta", "adam",
+                                 "rmsprop", "decayed_adagrad", "adamax"};
+  bool ok = false;
+  for (const char* k : kKnown) ok = ok || o->cfg.type == k;
+  if (!ok) { delete o; return nullptr; }
+  o->weights.assign(weights, weights + n);
+  return o;
+}
+
+void opt_destroy(Optimizer* o) { delete o; }
+
+// Mirrors paddle_update_parameter (optimizer.h:86).
+int opt_update(Optimizer* o, const float* grad, uint64_t n) {
+  if (!o || n != o->weights.size()) return -1;
+  o->Update(grad, n);
+  return 0;
+}
+
+// Sparse-row update; width * nrows elements in grad.
+int opt_update_rows(Optimizer* o, const float* grad, const int64_t* rows,
+                    uint64_t nrows, uint64_t width) {
+  if (!o || width == 0 || o->weights.size() % width != 0) return -1;
+  uint64_t height = o->weights.size() / width;
+  for (uint64_t r = 0; r < nrows; ++r) {
+    if (rows[r] < 0 || static_cast<uint64_t>(rows[r]) >= height) return -2;
+  }
+  o->UpdateRows(grad, rows, nrows, width);
+  return 0;
+}
+
+uint64_t opt_weight_count(Optimizer* o) { return o ? o->weights.size() : 0; }
+
+// Mirrors paddle_optimizer_get_weights (optimizer.h:94).
+int opt_get_weights(Optimizer* o, float* out, uint64_t cap) {
+  if (!o || cap < o->weights.size()) return -1;
+  std::memcpy(out, o->weights.data(), o->weights.size() * sizeof(float));
+  return 0;
+}
+
+int64_t opt_step(Optimizer* o) { return o ? o->step : -1; }
+
+// State serialization (mirrors paddle_optimizer_get_state /
+// creation-from-state, optimizer.h:99-103).  Layout:
+//   u64 version | bytes cfg | u64 step | u64 nweights | f32*n weights |
+//   u64 nstate | per state: bytes name, f32*n values
+uint64_t opt_serialize_size(Optimizer* o) {
+  if (!o) return 0;
+  uint64_t sz = 8 + 8 + o->cfg_str.size() + 8 + 8 + o->weights.size() * 4 + 8;
+  for (auto& kv : o->state) sz += 8 + kv.first.size() + 8 + kv.second.size() * 4;
+  return sz;
+}
+
+int64_t opt_serialize(Optimizer* o, uint8_t* buf, uint64_t cap) {
+  if (!o) return -1;
+  std::string out;
+  out.reserve(opt_serialize_size(o));
+  PutU64(&out, 1);  // version
+  PutBytes(&out, o->cfg_str.data(), o->cfg_str.size());
+  PutU64(&out, static_cast<uint64_t>(o->step));
+  PutBytes(&out, o->weights.data(), o->weights.size() * 4);
+  PutU64(&out, o->state.size());
+  for (auto& kv : o->state) {
+    PutBytes(&out, kv.first.data(), kv.first.size());
+    PutBytes(&out, kv.second.data(), kv.second.size() * 4);
+  }
+  if (out.size() > cap) return -1;
+  std::memcpy(buf, out.data(), out.size());
+  return static_cast<int64_t>(out.size());
+}
+
+Optimizer* opt_deserialize(const uint8_t* buf, uint64_t len) {
+  const uint8_t* p = buf;
+  const uint8_t* end = buf + len;
+  uint64_t ver, n;
+  if (!GetU64(&p, end, &ver) || ver != 1) return nullptr;
+  if (!GetU64(&p, end, &n) || static_cast<uint64_t>(end - p) < n) return nullptr;
+  std::string cfg(reinterpret_cast<const char*>(p), n);
+  p += n;
+  uint64_t step;
+  if (!GetU64(&p, end, &step)) return nullptr;
+  if (!GetU64(&p, end, &n) || static_cast<uint64_t>(end - p) < n) return nullptr;
+  auto* o = new Optimizer();
+  o->cfg_str = cfg;
+  o->cfg = ParseConfig(cfg);
+  o->step = static_cast<int64_t>(step);
+  o->weights.resize(n / 4);
+  std::memcpy(o->weights.data(), p, n);
+  p += n;
+  uint64_t nstate;
+  if (!GetU64(&p, end, &nstate)) { delete o; return nullptr; }
+  for (uint64_t i = 0; i < nstate; ++i) {
+    uint64_t ln;
+    if (!GetU64(&p, end, &ln) || static_cast<uint64_t>(end - p) < ln) { delete o; return nullptr; }
+    std::string name(reinterpret_cast<const char*>(p), ln);
+    p += ln;
+    if (!GetU64(&p, end, &ln) || static_cast<uint64_t>(end - p) < ln) { delete o; return nullptr; }
+    std::vector<float> vals(ln / 4);
+    std::memcpy(vals.data(), p, ln);
+    p += ln;
+    o->state.emplace(std::move(name), std::move(vals));
+  }
+  return o;
+}
+
+}  // extern "C"

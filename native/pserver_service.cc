@@ -1,0 +1,459 @@
+// Parameter server service: sharded parameters, server-side optimizer,
+// periodic CRC32-guarded checkpoints.
+//
+// C++ rebuild of the Go pserver (reference: go/pserver/service.go —
+// InitParam/FinishInitParams/SendGrad/GetParam RPCs :119-:285, periodic
+// gob+CRC32 checkpoint :119-:174) and of the C++ ParameterServer2's
+// sparse-row update path (reference: pserver/ParameterServer2.h:73,468).
+// Each parameter is owned by exactly one pserver shard (the client does
+// name-hash placement, mirroring go/pserver/client/client.go:51); the
+// optimizer runs server-side via the C-ABI optimizer library
+// (native/optimizer.cc, mirroring the cgo bridge go/pserver/optimizer.go).
+//
+// Wire protocol: one text line, then an optional length-prefixed binary
+// payload whose byte count appears in the line.
+//   PING                                   -> PONG
+//   INIT <name> <nbytes> <cfg...>\n<payload> -> OK | ERR <msg>
+//       payload = f32 initial values; cfg is the optimizer config string
+//       understood by opt_create (spaces allowed; rest of line).
+//   FININIT                                -> OK      (barrier: ready)
+//   GRAD <name> <nbytes>\n<payload>        -> OK | ERR ...
+//       payload = f32 dense gradient; blocks until the update is applied
+//       (sync SGD semantics; async falls out of clients not waiting on
+//        each other, exactly like the Go pserver).
+//   GRADROWS <name> <nrows> <width> <nbytes>\n<payload> -> OK
+//       payload = i64 rows[nrows] then f32 values[nrows*width]
+//       (sparse_remote_update path).
+//   GET <name>                             -> PARAM <name> <nbytes>\n<payload>
+//   GETALL                                 -> NAMES <k> <n1> <n2> ...
+//   STEP <name>                            -> STEP <k>
+//   CKPT                                   -> OK | ERR   (checkpoint now)
+//   SHUTDOWN                               -> OK
+//
+// Checkpoint file layout (atomic tmp+rename, mirrors the Go pserver's
+// crc32-checked gob blob): magic "PSCK1\n", u64 count, per-param
+// [u64 name_len, name, u64 state_len, state(opt_serialize)], u32 crc32
+// of everything after the magic.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <set>
+#include <memory>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+struct Optimizer;
+extern "C" {
+Optimizer* opt_create(const char* config, const float* weights, uint64_t n);
+void opt_destroy(Optimizer* o);
+int opt_update(Optimizer* o, const float* grad, uint64_t n);
+int opt_update_rows(Optimizer* o, const float* grad, const int64_t* rows,
+                    uint64_t nrows, uint64_t width);
+uint64_t opt_weight_count(Optimizer* o);
+int opt_get_weights(Optimizer* o, float* out, uint64_t cap);
+int64_t opt_step(Optimizer* o);
+uint64_t opt_serialize_size(Optimizer* o);
+int64_t opt_serialize(Optimizer* o, uint8_t* buf, uint64_t cap);
+Optimizer* opt_deserialize(const uint8_t* buf, uint64_t len);
+}
+
+namespace {
+
+// CRC32 (IEEE), table-driven — same polynomial as Go's hash/crc32 used
+// by the reference checkpoint (go/pserver/service.go:156).
+uint32_t Crc32(const uint8_t* data, size_t n, uint32_t crc = 0) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  crc = ~crc;
+  for (size_t i = 0; i < n; ++i) crc = table[(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+struct Param {
+  std::mutex mu;
+  Optimizer* opt = nullptr;
+  ~Param() { if (opt) opt_destroy(opt); }
+};
+
+struct PServer {
+  int port = 0;
+  int listen_fd = -1;
+  std::string ckpt_path;
+  int ckpt_sec = 0;
+  std::atomic<bool> stop{false};
+  std::atomic<bool> inited{false};  // FININIT barrier passed
+  std::mutex mu;                    // guards params map shape
+  std::map<std::string, std::unique_ptr<Param>> params;
+  std::thread accept_thread;
+  std::thread ckpt_thread;
+  std::vector<std::thread> conns;
+  std::set<int> live_fds;  // force-shutdown on stop so joins can't hang
+  std::mutex conns_mu;
+
+  bool Checkpoint(std::string* err) {
+    std::string body;
+    {
+      std::lock_guard<std::mutex> l(mu);
+      uint64_t count = params.size();
+      body.append(reinterpret_cast<const char*>(&count), 8);
+      for (auto& kv : params) {
+        std::lock_guard<std::mutex> pl(kv.second->mu);
+        uint64_t nlen = kv.first.size();
+        body.append(reinterpret_cast<const char*>(&nlen), 8);
+        body.append(kv.first);
+        uint64_t cap = opt_serialize_size(kv.second->opt);
+        std::vector<uint8_t> buf(cap);
+        int64_t n = opt_serialize(kv.second->opt, buf.data(), cap);
+        if (n < 0) { *err = "serialize failed"; return false; }
+        uint64_t slen = static_cast<uint64_t>(n);
+        body.append(reinterpret_cast<const char*>(&slen), 8);
+        body.append(reinterpret_cast<const char*>(buf.data()), slen);
+      }
+    }
+    uint32_t crc = Crc32(reinterpret_cast<const uint8_t*>(body.data()), body.size());
+    std::string tmp = ckpt_path + ".tmp";
+    {
+      std::ofstream f(tmp, std::ios::binary | std::ios::trunc);
+      if (!f) { *err = "cannot open " + tmp; return false; }
+      f << "PSCK1\n";
+      f.write(body.data(), static_cast<std::streamsize>(body.size()));
+      f.write(reinterpret_cast<const char*>(&crc), 4);
+      if (!f) { *err = "write failed"; return false; }
+    }
+    if (std::rename(tmp.c_str(), ckpt_path.c_str()) != 0) {
+      *err = "rename failed";
+      return false;
+    }
+    return true;
+  }
+
+  bool Recover(std::string* err) {
+    std::ifstream f(ckpt_path, std::ios::binary);
+    if (!f) { *err = "no checkpoint"; return false; }
+    std::string magic(6, 0);
+    f.read(&magic[0], 6);
+    if (magic != "PSCK1\n") { *err = "bad magic"; return false; }
+    std::string rest((std::istreambuf_iterator<char>(f)),
+                     std::istreambuf_iterator<char>());
+    if (rest.size() < 4) { *err = "truncated"; return false; }
+    std::string body = rest.substr(0, rest.size() - 4);
+    uint32_t crc;
+    std::memcpy(&crc, rest.data() + rest.size() - 4, 4);
+    if (crc != Crc32(reinterpret_cast<const uint8_t*>(body.data()), body.size())) {
+      *err = "crc mismatch";
+      return false;
+    }
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(body.data());
+    const uint8_t* end = p + body.size();
+    auto get_u64 = [&](uint64_t* v) {
+      if (end - p < 8) return false;
+      std::memcpy(v, p, 8);
+      p += 8;
+      return true;
+    };
+    uint64_t count;
+    if (!get_u64(&count)) { *err = "truncated"; return false; }
+    std::lock_guard<std::mutex> l(mu);
+    for (uint64_t i = 0; i < count; ++i) {
+      uint64_t nlen;
+      if (!get_u64(&nlen) || static_cast<uint64_t>(end - p) < nlen) { *err = "truncated"; return false; }
+      std::string name(reinterpret_cast<const char*>(p), nlen);
+      p += nlen;
+      uint64_t slen;
+      if (!get_u64(&slen) || static_cast<uint64_t>(end - p) < slen) { *err = "truncated"; return false; }
+      Optimizer* opt = opt_deserialize(p, slen);
+      p += slen;
+      if (!opt) { *err = "bad optimizer state"; return false; }
+      auto param = std::make_unique<Param>();
+      param->opt = opt;
+      params[name] = std::move(param);
+    }
+    inited.store(true);
+    return true;
+  }
+};
+
+bool ReadLine(int fd, std::string* line) {
+  line->clear();
+  char c;
+  while (true) {
+    ssize_t n = recv(fd, &c, 1, 0);
+    if (n <= 0) return false;
+    if (c == '\n') return true;
+    line->push_back(c);
+    if (line->size() > 1 << 16) return false;
+  }
+}
+
+bool ReadN(int fd, void* buf, size_t n) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool WriteAll(int fd, const void* buf, size_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool Reply(int fd, const std::string& s) { return WriteAll(fd, s.data(), s.size()); }
+
+void ServeConn(PServer* ps, int fd) {
+  std::string line;
+  while (!ps->stop.load() && ReadLine(fd, &line)) {
+    std::istringstream in(line);
+    std::string cmd;
+    in >> cmd;
+    if (cmd == "PING") {
+      Reply(fd, "PONG\n");
+    } else if (cmd == "INIT") {
+      std::string name;
+      uint64_t nbytes;
+      in >> name >> nbytes;
+      std::string cfg;
+      std::getline(in, cfg);
+      if (!cfg.empty() && cfg[0] == ' ') cfg.erase(0, 1);
+      if (nbytes % 4 != 0) {
+        // still drain the payload so the stream stays framed
+        std::vector<uint8_t> junk(nbytes);
+        if (!ReadN(fd, junk.data(), nbytes)) break;
+        Reply(fd, "ERR payload not f32-aligned\n");
+        continue;
+      }
+      std::vector<float> vals(nbytes / 4);
+      if (!ReadN(fd, vals.data(), nbytes)) break;
+      if (ps->inited.load()) {
+        // Late INIT after FinishInitParams is ignored (another trainer
+        // already initialized — go/pserver/service.go:AlreadyInitialized).
+        Reply(fd, "OK\n");
+        continue;
+      }
+      std::lock_guard<std::mutex> l(ps->mu);
+      if (!ps->params.count(name)) {
+        Optimizer* opt = opt_create(cfg.c_str(), vals.data(), vals.size());
+        if (!opt) {
+          Reply(fd, "ERR bad optimizer config: " + cfg + "\n");
+          continue;
+        }
+        auto param = std::make_unique<Param>();
+        param->opt = opt;
+        ps->params[name] = std::move(param);
+      }
+      Reply(fd, "OK\n");
+    } else if (cmd == "FININIT") {
+      ps->inited.store(true);
+      Reply(fd, "OK\n");
+    } else if (cmd == "GRAD" || cmd == "GRADROWS") {
+      std::string name;
+      uint64_t nrows = 0, width = 0, nbytes = 0;
+      in >> name;
+      if (cmd == "GRADROWS") in >> nrows >> width;
+      in >> nbytes;
+      std::vector<uint8_t> payload(nbytes);
+      if (!ReadN(fd, payload.data(), nbytes)) break;
+      if (!ps->inited.load()) { Reply(fd, "ERR uninitialized\n"); continue; }
+      if (cmd == "GRAD" ? (nbytes % 4 != 0)
+                        : (nbytes != nrows * 8 + nrows * width * 4)) {
+        Reply(fd, "ERR payload size mismatch\n");
+        continue;
+      }
+      Param* param = nullptr;
+      {
+        std::lock_guard<std::mutex> l(ps->mu);
+        auto it = ps->params.find(name);
+        if (it != ps->params.end()) param = it->second.get();
+      }
+      if (!param) { Reply(fd, "ERR unknown param " + name + "\n"); continue; }
+      int rc;
+      {
+        std::lock_guard<std::mutex> pl(param->mu);
+        if (cmd == "GRAD") {
+          rc = opt_update(param->opt,
+                          reinterpret_cast<const float*>(payload.data()),
+                          nbytes / 4);
+        } else {
+          const int64_t* rows = reinterpret_cast<const int64_t*>(payload.data());
+          const float* vals =
+              reinterpret_cast<const float*>(payload.data() + nrows * 8);
+          rc = opt_update_rows(param->opt, vals, rows, nrows, width);
+        }
+      }
+      Reply(fd, rc == 0 ? "OK\n" : "ERR update failed\n");
+    } else if (cmd == "GET") {
+      std::string name;
+      in >> name;
+      Param* param = nullptr;
+      {
+        std::lock_guard<std::mutex> l(ps->mu);
+        auto it = ps->params.find(name);
+        if (it != ps->params.end()) param = it->second.get();
+      }
+      if (!param) { Reply(fd, "ERR unknown param " + name + "\n"); continue; }
+      std::vector<float> w;
+      {
+        std::lock_guard<std::mutex> pl(param->mu);
+        w.resize(opt_weight_count(param->opt));
+        opt_get_weights(param->opt, w.data(), w.size());
+      }
+      std::ostringstream hdr;
+      hdr << "PARAM " << name << " " << w.size() * 4 << "\n";
+      if (!Reply(fd, hdr.str())) break;
+      if (!WriteAll(fd, w.data(), w.size() * 4)) break;
+    } else if (cmd == "GETALL") {
+      std::ostringstream out;
+      std::lock_guard<std::mutex> l(ps->mu);
+      out << "NAMES " << ps->params.size();
+      for (auto& kv : ps->params) out << " " << kv.first;
+      out << "\n";
+      Reply(fd, out.str());
+    } else if (cmd == "STEP") {
+      std::string name;
+      in >> name;
+      std::lock_guard<std::mutex> l(ps->mu);
+      auto it = ps->params.find(name);
+      if (it == ps->params.end()) { Reply(fd, "ERR unknown\n"); continue; }
+      std::ostringstream out;
+      out << "STEP " << opt_step(it->second->opt) << "\n";
+      Reply(fd, out.str());
+    } else if (cmd == "CKPT") {
+      std::string err;
+      if (ps->ckpt_path.empty()) Reply(fd, "ERR no checkpoint path\n");
+      else if (ps->Checkpoint(&err)) Reply(fd, "OK\n");
+      else Reply(fd, "ERR " + err + "\n");
+    } else if (cmd == "SHUTDOWN") {
+      Reply(fd, "OK\n");
+      ps->stop.store(true);
+      break;
+    } else {
+      Reply(fd, "ERR bad command\n");
+    }
+  }
+  {
+    std::lock_guard<std::mutex> l(ps->conns_mu);
+    ps->live_fds.erase(fd);
+  }
+  close(fd);
+}
+
+void AcceptLoop(PServer* ps) {
+  while (!ps->stop.load()) {
+    int fd = accept(ps->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (ps->stop.load()) break;
+      continue;
+    }
+    std::lock_guard<std::mutex> l(ps->conns_mu);
+    ps->live_fds.insert(fd);
+    ps->conns.emplace_back([ps, fd] { ServeConn(ps, fd); });
+  }
+}
+
+void CkptLoop(PServer* ps) {
+  auto last = std::chrono::steady_clock::now();
+  while (!ps->stop.load()) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    auto now = std::chrono::steady_clock::now();
+    if (std::chrono::duration_cast<std::chrono::seconds>(now - last).count() >=
+        ps->ckpt_sec) {
+      std::string err;
+      ps->Checkpoint(&err);
+      last = now;
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Start a pserver shard.  If checkpoint_path is non-empty and the file
+// exists, state is recovered from it (crash-restart contract,
+// go/pserver/service.go:174); if ckpt_sec > 0 a periodic checkpoint
+// thread runs.
+PServer* pserver_start(int port, const char* checkpoint_path, int ckpt_sec) {
+  auto* ps = new PServer();
+  ps->ckpt_path = checkpoint_path ? checkpoint_path : "";
+  ps->ckpt_sec = ckpt_sec;
+  ps->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (ps->listen_fd < 0) { delete ps; return nullptr; }
+  int one = 1;
+  setsockopt(ps->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(ps->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      listen(ps->listen_fd, 64) < 0) {
+    close(ps->listen_fd);
+    delete ps;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(ps->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  ps->port = ntohs(addr.sin_port);
+  if (!ps->ckpt_path.empty()) {
+    std::string err;
+    ps->Recover(&err);  // best-effort: fresh start if no/invalid file
+  }
+  ps->accept_thread = std::thread(AcceptLoop, ps);
+  if (ps->ckpt_sec > 0 && !ps->ckpt_path.empty())
+    ps->ckpt_thread = std::thread(CkptLoop, ps);
+  return ps;
+}
+
+int pserver_port(PServer* ps) { return ps ? ps->port : -1; }
+
+void pserver_stop(PServer* ps) {
+  if (!ps) return;
+  ps->stop.store(true);
+  shutdown(ps->listen_fd, SHUT_RDWR);
+  close(ps->listen_fd);
+  if (ps->accept_thread.joinable()) ps->accept_thread.join();
+  if (ps->ckpt_thread.joinable()) ps->ckpt_thread.join();
+  {
+    std::lock_guard<std::mutex> l(ps->conns_mu);
+    for (int cfd : ps->live_fds) shutdown(cfd, SHUT_RDWR);
+  }
+  // join OUTSIDE conns_mu: exiting conn threads take it to deregister
+  std::vector<std::thread> done;
+  {
+    std::lock_guard<std::mutex> l(ps->conns_mu);
+    done.swap(ps->conns);
+  }
+  for (auto& t : done) if (t.joinable()) t.join();
+  delete ps;
+}
+
+}  // extern "C"

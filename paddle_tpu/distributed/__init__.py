@@ -4,3 +4,5 @@ SPMD collective configuration (paddle_tpu.parallel)."""
 
 from paddle_tpu.distributed.master import MasterServer
 from paddle_tpu.distributed.master_client import MasterClient
+from paddle_tpu.distributed.pserver_client import ParameterServer, PServerClient
+from paddle_tpu.distributed.coord import CoordServer, CoordClient

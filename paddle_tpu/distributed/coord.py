@@ -1,0 +1,186 @@
+"""Client for the native coordination store (the etcd replacement).
+
+Reference semantics being reproduced:
+  - pserver index claim by STM transaction + TTL lease keepalive
+    (go/pserver/etcd_client.go:70 Register, :170 registerPserverEtcd)
+  - master election + address publication, clients watching the master
+    key (go/master/etcd_client.go; go/master/client.go:186 monitorMaster)
+  - checkpoint metadata storage (go/pserver/service.go:270-283)
+
+The store itself is native/coord_store.cc (single-node; etcd's raft
+replication is out of scope the same way the reference assumed an
+externally-operated etcd cluster).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+
+class CoordServer:
+    """Starts the native coordination store on localhost."""
+
+    def __init__(self, port: int = 0):
+        from paddle_tpu.native import lib
+
+        self._lib = lib()
+        self._h = self._lib.coord_start(port)
+        if not self._h:
+            raise RuntimeError("failed to start coordination store")
+        self.port = self._lib.coord_port(self._h)
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def stop(self):
+        if self._h:
+            self._lib.coord_stop(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+
+
+def _hex(b: bytes) -> str:
+    return b.hex() if b else "-"
+
+
+class CoordClient:
+    def __init__(self, addr: str):
+        host, port = addr.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)))
+        self._rfile = self._sock.makefile("rb")
+        self._lock = threading.Lock()
+        self._keepalive_stop = None
+
+    def _req(self, line: str) -> str:
+        with self._lock:
+            self._sock.sendall(line.encode() + b"\n")
+            resp = self._rfile.readline().decode().strip()
+        if resp.startswith("ERR"):
+            raise RuntimeError(resp)
+        return resp
+
+    # -- KV --------------------------------------------------------------
+    def put(self, key: str, value: bytes, lease: int = 0) -> int:
+        resp = self._req(f"PUT {key} {_hex(value)} {lease}")
+        return int(resp.split()[1])
+
+    def get(self, key: str):
+        """-> (rev, value) or None."""
+        resp = self._req(f"GET {key}")
+        if resp == "NONE":
+            return None
+        _, rev, hexval = resp.split()
+        return int(rev), b"" if hexval == "-" else bytes.fromhex(hexval)
+
+    def delete(self, key: str):
+        self._req(f"DEL {key}")
+
+    def cas(self, key: str, old, new: bytes, lease: int = 0) -> bool:
+        """Compare-and-swap; old=None means create-if-absent."""
+        resp = self._req(
+            f"CAS {key} {_hex(old) if old is not None else '-'} {_hex(new)} {lease}")
+        return resp.startswith("OK")
+
+    def wait(self, key: str, rev: int, timeout_ms: int = 5000):
+        """Block until key's revision exceeds rev (watch-by-poll).
+        -> (rev, value), None (deleted), or 'timeout'."""
+        resp = self._req(f"WAIT {key} {rev} {timeout_ms}")
+        if resp == "TIMEOUT":
+            return "timeout"
+        if resp == "NONE":
+            return None
+        _, r, hexval = resp.split()
+        return int(r), b"" if hexval == "-" else bytes.fromhex(hexval)
+
+    # -- leases ----------------------------------------------------------
+    def lease(self, ttl_sec: int) -> int:
+        return int(self._req(f"LEASE {ttl_sec}").split()[1])
+
+    def keepalive(self, lease_id: int):
+        self._req(f"KEEPALIVE {lease_id}")
+
+    def revoke(self, lease_id: int):
+        self._req(f"REVOKE {lease_id}")
+
+    def keepalive_loop(self, lease_id: int, period_sec: float):
+        """Background keepalive thread (the Go client's lease.KeepAlive)."""
+        stop = threading.Event()
+
+        def _loop():
+            while not stop.wait(period_sec):
+                try:
+                    self.keepalive(lease_id)
+                except (RuntimeError, OSError):
+                    return
+
+        t = threading.Thread(target=_loop, daemon=True)
+        t.start()
+        return stop
+
+    # -- runtime patterns ------------------------------------------------
+    PSERVER_PREFIX = "/ps/"
+    MASTER_KEY = "/master/addr"
+
+    def register_pserver(self, addr: str, num_pservers: int, ttl_sec: int = 5):
+        """Claim the first free pserver index slot (the STM loop of
+        go/pserver/etcd_client.go:170).  Returns (index, lease_id)."""
+        lease_id = self.lease(ttl_sec)
+        while True:
+            for idx in range(num_pservers):
+                key = f"{self.PSERVER_PREFIX}{idx}"
+                if self.cas(key, None, addr.encode(), lease=lease_id):
+                    return idx, lease_id
+                cur = self.get(key)
+                # dead pserver's lease expired between GET and CAS: retry
+                if cur is None:
+                    continue
+            time.sleep(0.2)
+
+    def pserver_addrs(self, num_pservers: int):
+        out = {}
+        for idx in range(num_pservers):
+            got = self.get(f"{self.PSERVER_PREFIX}{idx}")
+            if got is not None:
+                out[idx] = got[1].decode()
+        return out
+
+    def elect_master(self, addr: str, ttl_sec: int = 5):
+        """Win or lose the master election; winner publishes its addr
+        under a lease so a crash frees the slot (go/master/etcd_client.go).
+        Returns lease_id if elected, else None."""
+        lease_id = self.lease(ttl_sec)
+        if self.cas(self.MASTER_KEY, None, addr.encode(), lease=lease_id):
+            return lease_id
+        self.revoke(lease_id)
+        return None
+
+    def master_addr(self, wait_timeout_ms: int = 0):
+        got = self.get(self.MASTER_KEY)
+        if got is not None:
+            return got[1].decode()
+        if wait_timeout_ms:
+            got = self.wait(self.MASTER_KEY, 0, wait_timeout_ms)
+            if got not in (None, "timeout"):
+                return got[1].decode()
+        return None
+
+    def close(self):
+        try:
+            self._rfile.close()
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()

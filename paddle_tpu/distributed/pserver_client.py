@@ -1,0 +1,167 @@
+"""Parameter-server shard handle + trainer-side client.
+
+Reference: go/pserver/client/client.go (name-hash parameter placement
+:51, SendGrads fan-out :145, GetParams :192) and the C exports consumed
+by NewRemoteParameterUpdater (go/pserver/client/c/cclient.go:113-224).
+The service itself is native/pserver_service.cc; the per-parameter
+optimizer is native/optimizer.cc (reference paddle/optimizer).
+
+Gradient exchange between *chips* rides XLA collectives over ICI
+(paddle_tpu/parallel); this DCN parameter service covers the
+capabilities collectives can't: async SGD, sparse embedding shards too
+big for HBM, and crash-recovery checkpoints.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import zlib
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+
+class ParameterServer:
+    """Starts one native pserver shard on localhost."""
+
+    def __init__(self, port: int = 0, checkpoint_path: str = "",
+                 checkpoint_sec: int = 0):
+        from paddle_tpu.native import lib
+
+        self._lib = lib()
+        self._h = self._lib.pserver_start(port, checkpoint_path.encode(),
+                                          checkpoint_sec)
+        if not self._h:
+            raise RuntimeError("failed to start pserver")
+        self.port = self._lib.pserver_port(self._h)
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def stop(self):
+        if self._h:
+            self._lib.pserver_stop(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+
+
+class _Conn:
+    def __init__(self, addr: str):
+        host, port = addr.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)))
+        self._rfile = self._sock.makefile("rb")
+        self._lock = threading.Lock()
+
+    def request(self, line: str, payload: bytes = b"",
+                want_payload: bool = False):
+        with self._lock:
+            self._sock.sendall(line.encode() + b"\n" + payload)
+            resp = self._rfile.readline().decode().strip()
+            if resp.startswith("ERR"):
+                raise RuntimeError(resp)
+            if want_payload:
+                nbytes = int(resp.split()[-1])
+                return resp, self._rfile.read(nbytes)
+            return resp, b""
+
+    def close(self):
+        try:
+            self._rfile.close()
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _shard_of(name: str, n: int) -> int:
+    """Deterministic name->shard placement (go/pserver/client/client.go:51
+    hashes the param name; crc32 here for a stable cross-process hash)."""
+    return zlib.crc32(name.encode()) % n
+
+
+class PServerClient:
+    """Trainer-side client over one or more pserver shards."""
+
+    def __init__(self, addrs):
+        self.addrs = list(addrs)
+        self._conns = [_Conn(a) for a in self.addrs]
+        # persistent pool: per-batch thread churn off the hot loop; more
+        # workers than shards is useless (per-conn lock serializes)
+        self._pool = ThreadPoolExecutor(max_workers=max(len(self._conns), 1))
+
+    def _conn(self, name: str) -> _Conn:
+        return self._conns[_shard_of(name, len(self._conns))]
+
+    def init_param(self, name: str, value: np.ndarray, optimizer: str = "type=sgd lr=0.01"):
+        buf = np.ascontiguousarray(value, dtype=np.float32).tobytes()
+        self._conn(name).request(f"INIT {name} {len(buf)} {optimizer}", buf)
+
+    def finish_init(self):
+        for c in self._conns:
+            c.request("FININIT")
+
+    def send_grad(self, name: str, grad: np.ndarray):
+        buf = np.ascontiguousarray(grad, dtype=np.float32).tobytes()
+        self._conn(name).request(f"GRAD {name} {len(buf)}", buf)
+
+    def send_grad_rows(self, name: str, rows: np.ndarray, values: np.ndarray):
+        """Sparse-row gradient (sparse_remote_update semantics —
+        trainer sends only touched embedding rows,
+        trainer/RemoteParameterUpdater.h:265)."""
+        rows = np.ascontiguousarray(rows, dtype=np.int64)
+        values = np.ascontiguousarray(values, dtype=np.float32)
+        nrows, width = values.shape
+        buf = rows.tobytes() + values.tobytes()
+        self._conn(name).request(
+            f"GRADROWS {name} {nrows} {width} {len(buf)}", buf)
+
+    def send_grads(self, grads: dict):
+        """Fan-out: all shards in parallel (client.go:145 SendGrads)."""
+
+        def _send(item):
+            name, g = item
+            if isinstance(g, tuple):
+                self.send_grad_rows(name, *g)
+            else:
+                self.send_grad(name, g)
+
+        for f in [self._pool.submit(_send, it) for it in grads.items()]:
+            f.result()
+
+    def get_param(self, name: str, shape=None) -> np.ndarray:
+        _, payload = self._conn(name).request(f"GET {name}", want_payload=True)
+        arr = np.frombuffer(payload, dtype=np.float32).copy()
+        return arr.reshape(shape) if shape is not None else arr
+
+    def get_params(self, names) -> dict:
+        futures = {n: self._pool.submit(self.get_param, n) for n in names}
+        return {n: f.result() for n, f in futures.items()}
+
+    def param_names(self):
+        names = set()
+        for c in self._conns:
+            resp, _ = c.request("GETALL")
+            parts = resp.split()
+            names.update(parts[2:])
+        return sorted(names)
+
+    def checkpoint(self):
+        for c in self._conns:
+            c.request("CKPT")
+
+    def close(self):
+        self._pool.shutdown(wait=True)
+        for c in self._conns:
+            c.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()

@@ -28,6 +28,7 @@ from paddle_tpu import framework
 from paddle_tpu.framework import Program, Variable, TPUPlace, Place
 from paddle_tpu.lod import LoDArray
 from paddle_tpu.registry import LowerContext, OpRegistry, RngState
+from paddle_tpu.sparse import SparseGrad
 
 
 # ---------------------------------------------------------------------------
@@ -265,6 +266,9 @@ class Executor:
             if return_numpy:
                 if isinstance(v, LoDArray):
                     v = LoDArray(np.asarray(v.data), tuple(np.asarray(o) for o in v.lod))
+                elif isinstance(v, SparseGrad):
+                    v = SparseGrad(np.asarray(v.rows), np.asarray(v.values),
+                                   v.height)
                 else:
                     v = np.asarray(v)
             out.append(v)

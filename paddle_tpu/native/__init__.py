@@ -12,7 +12,8 @@ import threading
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _SRC_DIR = os.path.join(_REPO_ROOT, "native")
 _LIB_PATH = os.path.join(_SRC_DIR, "libpaddle_tpu_native.so")
-_SOURCES = ["recordio.cc", "data_loader.cc", "master_service.cc"]
+_SOURCES = ["recordio.cc", "data_loader.cc", "master_service.cc",
+            "optimizer.cc", "pserver_service.cc", "coord_store.cc"]
 
 _lock = threading.Lock()
 _lib = None
@@ -63,6 +64,51 @@ def lib() -> ctypes.CDLL:
             l.master_port.restype = ctypes.c_int
             l.master_port.argtypes = [ctypes.c_void_p]
             l.master_stop.argtypes = [ctypes.c_void_p]
+            # optimizer C lib (reference paddle/optimizer/optimizer.h)
+            l.opt_create.restype = ctypes.c_void_p
+            l.opt_create.argtypes = [ctypes.c_char_p,
+                                     ctypes.POINTER(ctypes.c_float),
+                                     ctypes.c_uint64]
+            l.opt_destroy.argtypes = [ctypes.c_void_p]
+            l.opt_update.restype = ctypes.c_int
+            l.opt_update.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_float),
+                                     ctypes.c_uint64]
+            l.opt_update_rows.restype = ctypes.c_int
+            l.opt_update_rows.argtypes = [ctypes.c_void_p,
+                                          ctypes.POINTER(ctypes.c_float),
+                                          ctypes.POINTER(ctypes.c_int64),
+                                          ctypes.c_uint64, ctypes.c_uint64]
+            l.opt_weight_count.restype = ctypes.c_uint64
+            l.opt_weight_count.argtypes = [ctypes.c_void_p]
+            l.opt_get_weights.restype = ctypes.c_int
+            l.opt_get_weights.argtypes = [ctypes.c_void_p,
+                                          ctypes.POINTER(ctypes.c_float),
+                                          ctypes.c_uint64]
+            l.opt_step.restype = ctypes.c_int64
+            l.opt_step.argtypes = [ctypes.c_void_p]
+            l.opt_serialize_size.restype = ctypes.c_uint64
+            l.opt_serialize_size.argtypes = [ctypes.c_void_p]
+            l.opt_serialize.restype = ctypes.c_int64
+            l.opt_serialize.argtypes = [ctypes.c_void_p,
+                                        ctypes.POINTER(ctypes.c_uint8),
+                                        ctypes.c_uint64]
+            l.opt_deserialize.restype = ctypes.c_void_p
+            l.opt_deserialize.argtypes = [ctypes.POINTER(ctypes.c_uint8),
+                                          ctypes.c_uint64]
+            # pserver service
+            l.pserver_start.restype = ctypes.c_void_p
+            l.pserver_start.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                        ctypes.c_int]
+            l.pserver_port.restype = ctypes.c_int
+            l.pserver_port.argtypes = [ctypes.c_void_p]
+            l.pserver_stop.argtypes = [ctypes.c_void_p]
+            # coordination store (etcd equivalent)
+            l.coord_start.restype = ctypes.c_void_p
+            l.coord_start.argtypes = [ctypes.c_int]
+            l.coord_port.restype = ctypes.c_int
+            l.coord_port.argtypes = [ctypes.c_void_p]
+            l.coord_stop.argtypes = [ctypes.c_void_p]
             _lib = l
     return _lib
 

@@ -22,6 +22,10 @@ class Optimizer:
             from paddle_tpu.clip import GradientClipByGlobalNorm
 
             clip = GradientClipByGlobalNorm(gradient_clipping_threshold)
+        self._lr = learning_rate
+        self._lr_decay_a = learning_rate_decay_a
+        self._lr_decay_b = learning_rate_decay_b
+        self._clip = clip
         self._core = self._make_core(learning_rate, grad_clip=clip, **kwargs)
         self.regularization = regularization
 
@@ -31,6 +35,36 @@ class Optimizer:
     def minimize(self, loss, startup_program=None):
         return self._core.minimize(loss, startup_program=startup_program)
 
+    def server_config(self) -> str:
+        """Config string for the server-side optimizer library
+        (remote training path; reference: v2/optimizer.py:53-65 built a
+        pserver updater from the same object)."""
+        cfg = self._server_config_body()
+        if self._lr_decay_a is not None:
+            cfg += (f" lr_policy=linear lr_decay_a={self._lr_decay_a}"
+                    f" lr_decay_b={self._lr_decay_b or 0.0}")
+        if self.regularization is not None:
+            from paddle_tpu import regularizer as core_reg
+
+            if isinstance(self.regularization, core_reg.L2DecayRegularizer):
+                cfg += f" decay={self.regularization._coeff}"
+            else:
+                raise ValueError(
+                    "remote training supports only L2 regularization "
+                    "(server-side decay); got "
+                    f"{type(self.regularization).__name__}")
+        if self._clip is not None:
+            import warnings
+
+            warnings.warn(
+                "gradient_clipping_threshold is applied trainer-side in "
+                "remote mode is not implemented; gradients are sent "
+                "unclipped", stacklevel=2)
+        return cfg
+
+    def _server_config_body(self) -> str:
+        return f"type=sgd lr={self._lr}"
+
 
 class Momentum(Optimizer):
     def __init__(self, momentum=0.9, sparse=False, **kwargs):
@@ -39,6 +73,9 @@ class Momentum(Optimizer):
 
     def _make_core(self, lr, **kwargs):
         return core_opt.MomentumOptimizer(lr, self._momentum, **kwargs)
+
+    def _server_config_body(self):
+        return f"type=sgd lr={self._lr} momentum={self._momentum}"
 
 
 class Adam(Optimizer):
@@ -50,6 +87,10 @@ class Adam(Optimizer):
         return core_opt.AdamOptimizer(lr, beta1=self._b1, beta2=self._b2,
                                       epsilon=self._eps, **kwargs)
 
+    def _server_config_body(self):
+        return (f"type=adam lr={self._lr} beta1={self._b1} beta2={self._b2}"
+                f" epsilon={self._eps}")
+
 
 class Adamax(Optimizer):
     def __init__(self, beta1=0.9, beta2=0.999, **kwargs):
@@ -60,10 +101,16 @@ class Adamax(Optimizer):
         return core_opt.AdamaxOptimizer(lr, beta1=self._b1, beta2=self._b2,
                                         **kwargs)
 
+    def _server_config_body(self):
+        return f"type=adamax lr={self._lr} beta1={self._b1} beta2={self._b2}"
+
 
 class AdaGrad(Optimizer):
     def _make_core(self, lr, **kwargs):
         return core_opt.AdagradOptimizer(lr, **kwargs)
+
+    def _server_config_body(self):
+        return f"type=adagrad lr={self._lr}"
 
 
 class DecayedAdaGrad(Optimizer):
@@ -75,6 +122,10 @@ class DecayedAdaGrad(Optimizer):
         return core_opt.DecayedAdagradOptimizer(lr, decay=self._rho,
                                                 epsilon=self._eps, **kwargs)
 
+    def _server_config_body(self):
+        return (f"type=decayed_adagrad lr={self._lr} rho={self._rho}"
+                f" epsilon={self._eps}")
+
 
 class AdaDelta(Optimizer):
     def __init__(self, rho=0.95, epsilon=1e-6, **kwargs):
@@ -85,6 +136,9 @@ class AdaDelta(Optimizer):
         return core_opt.AdadeltaOptimizer(lr, rho=self._rho,
                                           epsilon=self._eps, **kwargs)
 
+    def _server_config_body(self):
+        return f"type=adadelta lr={self._lr} rho={self._rho} epsilon={self._eps}"
+
 
 class RMSProp(Optimizer):
     def __init__(self, rho=0.95, epsilon=1e-6, **kwargs):
@@ -94,6 +148,9 @@ class RMSProp(Optimizer):
     def _make_core(self, lr, **kwargs):
         return core_opt.RMSPropOptimizer(lr, rho=self._rho, epsilon=self._eps,
                                          **kwargs)
+
+    def _server_config_body(self):
+        return f"type=rmsprop lr={self._lr} rho={self._rho} epsilon={self._eps}"
 
 
 # regularization helpers matching the reference surface

@@ -10,6 +10,7 @@ import numpy as np
 
 from paddle_tpu import executor as executor_mod
 from paddle_tpu import framework
+from paddle_tpu.sparse import SparseGrad
 from paddle_tpu.executor import Executor
 from paddle_tpu.framework import TPUPlace
 from paddle_tpu.v2 import event as v2_event
@@ -74,23 +75,86 @@ class SGD:
 
     def __init__(self, cost: LayerOutput, parameters: Parameters,
                  update_equation, extra_layers=None, is_local: bool = True,
-                 **kwargs):
+                 pserver_addrs=None, **kwargs):
         if cost._topology is not None and parameters.topology is cost._topology:
             self.topology = parameters.topology
         else:
             self.topology = parameters.topology
         self.parameters = parameters
         self._extra = list(extra_layers or [])
-        with framework.program_guard(self.topology.main_program,
-                                     self.topology.startup_program):
-            update_equation.minimize(self.topology.cost_var,
-                                     startup_program=self.topology.startup_program)
+        self._remote = None
+        if is_local:
+            with framework.program_guard(self.topology.main_program,
+                                         self.topology.startup_program):
+                update_equation.minimize(
+                    self.topology.cost_var,
+                    startup_program=self.topology.startup_program)
+        else:
+            # Remote training (reference: NewRemoteParameterUpdater,
+            # trainer/NewRemoteParameterUpdater.cpp:48-127): the local
+            # program stops at gradients; the optimizer runs server-side
+            # on the parameter-server shards.
+            from paddle_tpu import backward as backward_mod
+
+            if not pserver_addrs:
+                raise ValueError("is_local=False requires pserver_addrs")
+            with framework.program_guard(self.topology.main_program,
+                                         self.topology.startup_program):
+                param_grads = backward_mod.append_backward(
+                    self.topology.cost_var)
+            self._param_grads = [(p.name, g.name) for p, g in param_grads]
+            self._server_cfg = update_equation.server_config()
+            self._pserver_addrs = list(pserver_addrs)
         # startup may have grown (lr/accumulators): re-init the new vars
         exe = Executor(TPUPlace())
         with executor_mod.scope_guard(self.parameters.scope):
             exe.run(self.topology.startup_program)
         self._exe = exe
         self._test_program = None
+        if not is_local:
+            from paddle_tpu.distributed import PServerClient
+
+            self._remote = PServerClient(self._pserver_addrs)
+            # First trainer wins the init race server-side; late INITs
+            # are no-ops (go/pserver/service.go AlreadyInitialized).
+            for pname, _ in self._param_grads:
+                self._remote.init_param(pname, self.parameters.get(pname),
+                                        optimizer=self._server_cfg)
+            self._remote.finish_init()
+            # Pull the winning values so a losing trainer doesn't start
+            # from its own init (NewRemoteParameterUpdater does GetParams
+            # right after FinishInitParams).
+            self._pull_params()
+
+    def _pull_params(self):
+        fresh = self._remote.get_params([p for p, _ in self._param_grads])
+        for pname, _ in self._param_grads:
+            self.parameters.set(
+                pname, fresh[pname].reshape(self.parameters.get_shape(pname)))
+
+    def _remote_step(self, feed, fetch):
+        """One batch against the pserver: local fwd/bwd, ship grads,
+        pull fresh params (RemoteParameterUpdater.finishBatch order)."""
+        grad_names = [g for _, g in self._param_grads]
+        with executor_mod.scope_guard(self.parameters.scope):
+            outs = self._exe.run(self.topology.main_program, feed=feed,
+                                 fetch_list=fetch + grad_names)
+        cost = outs[0]
+        grads = outs[len(fetch):]
+        payload = {}
+        for (pname, _), g in zip(self._param_grads, grads):
+            if isinstance(g, SparseGrad):
+                # merge duplicate rows client-side so one RPC row means
+                # one optimizer application (SelectedRows merge_dup_rows)
+                uniq, inv = np.unique(np.asarray(g.rows), return_inverse=True)
+                merged = np.zeros((uniq.size, g.values.shape[1]), np.float32)
+                np.add.at(merged, inv, np.asarray(g.values, np.float32))
+                payload[pname] = (uniq.astype(np.int64), merged)
+            else:
+                payload[pname] = np.asarray(g)
+        self._remote.send_grads(payload)
+        self._pull_params()
+        return cost
 
     def train(self, reader: Callable, num_passes: int = 1,
               event_handler: Optional[Callable] = None,
@@ -103,9 +167,12 @@ class SGD:
             for batch_id, data in enumerate(reader()):
                 event_handler(v2_event.BeginIteration(pass_id, batch_id))
                 feed = feeder.feed(data)
-                with executor_mod.scope_guard(self.parameters.scope):
-                    (cost,) = self._exe.run(self.topology.main_program,
-                                            feed=feed, fetch_list=fetch)
+                if self._remote is not None:
+                    cost = self._remote_step(feed, fetch)
+                else:
+                    with executor_mod.scope_guard(self.parameters.scope):
+                        (cost,) = self._exe.run(self.topology.main_program,
+                                                feed=feed, fetch_list=fetch)
                 event_handler(v2_event.EndIteration(
                     pass_id, batch_id, float(np.asarray(cost).reshape(-1)[0])))
             event_handler(v2_event.EndPass(pass_id))

@@ -1,0 +1,129 @@
+"""Coordination-store tests (etcd-semantics subset).
+
+Reference models: go/pserver/etcd_client.go:170 (STM index claim),
+go/master/etcd_client.go (election + addr publication),
+go/master/client.go:186 (addr watch), lease TTL expiry freeing keys.
+"""
+
+import threading
+import time
+
+import numpy as np  # noqa: F401  (keeps import style uniform with suite)
+
+from paddle_tpu.distributed import CoordClient, CoordServer
+
+
+def test_kv_put_get_del():
+    with CoordServer() as s, CoordClient(s.address) as c:
+        assert c.get("k") is None
+        rev1 = c.put("k", b"hello world")
+        got = c.get("k")
+        assert got == (rev1, b"hello world")
+        rev2 = c.put("k", b"\x00\xff binary ok")
+        assert rev2 > rev1
+        assert c.get("k")[1] == b"\x00\xff binary ok"
+        c.delete("k")
+        assert c.get("k") is None
+
+
+def test_cas_create_if_absent_and_swap():
+    with CoordServer() as s, CoordClient(s.address) as c:
+        assert c.cas("slot", None, b"a")
+        assert not c.cas("slot", None, b"b")       # already exists
+        assert not c.cas("slot", b"wrong", b"b")   # value mismatch
+        assert c.cas("slot", b"a", b"b")
+        assert c.get("slot")[1] == b"b"
+
+
+def test_lease_expiry_deletes_keys():
+    with CoordServer() as s, CoordClient(s.address) as c:
+        lease = c.lease(1)
+        c.put("ephemeral", b"x", lease=lease)
+        assert c.get("ephemeral") is not None
+        time.sleep(1.6)
+        assert c.get("ephemeral") is None
+
+
+def test_keepalive_extends_lease():
+    with CoordServer() as s, CoordClient(s.address) as c:
+        lease = c.lease(1)
+        c.put("k", b"x", lease=lease)
+        stop = c.keepalive_loop(lease, period_sec=0.3)
+        time.sleep(1.8)
+        assert c.get("k") is not None   # kept alive past the 1s TTL
+        stop.set()
+        time.sleep(1.6)
+        assert c.get("k") is None       # expired once keepalive stopped
+
+
+def test_wait_unblocks_on_put():
+    with CoordServer() as s:
+        c1 = CoordClient(s.address)
+        c2 = CoordClient(s.address)
+        result = {}
+
+        def waiter():
+            result["got"] = c1.wait("announce", 0, timeout_ms=5000)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.2)
+        c2.put("announce", b"addr:1234")
+        t.join(timeout=5)
+        assert result["got"][1] == b"addr:1234"
+        assert c1.wait("announce", result["got"][0], timeout_ms=100) == "timeout"
+        c1.close(); c2.close()
+
+
+def test_pserver_registration_claims_distinct_slots():
+    with CoordServer() as s:
+        clients = [CoordClient(s.address) for _ in range(3)]
+        results = []
+        lock = threading.Lock()
+
+        def register(c, addr):
+            idx, lease = c.register_pserver(addr, num_pservers=3)
+            with lock:
+                results.append((idx, addr))
+
+        threads = [threading.Thread(target=register, args=(c, f"host:{i}"))
+                   for i, c in enumerate(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(i for i, _ in results) == [0, 1, 2]
+        addrs = clients[0].pserver_addrs(3)
+        assert len(addrs) == 3
+        for c in clients:
+            c.close()
+
+
+def test_dead_pserver_slot_reclaimed():
+    with CoordServer() as s:
+        c1 = CoordClient(s.address)
+        idx, lease = c1.register_pserver("old:1", num_pservers=1, ttl_sec=1)
+        assert idx == 0
+        c1.revoke(lease)  # simulate crash (lease gone)
+        c2 = CoordClient(s.address)
+        idx2, _ = c2.register_pserver("new:2", num_pservers=1, ttl_sec=5)
+        assert idx2 == 0
+        assert c2.pserver_addrs(1)[0] == "new:2"
+        c1.close(); c2.close()
+
+
+def test_master_election_single_winner():
+    with CoordServer() as s:
+        c1 = CoordClient(s.address)
+        c2 = CoordClient(s.address)
+        l1 = c1.elect_master("m1:7000", ttl_sec=5)
+        l2 = c2.elect_master("m2:7000", ttl_sec=5)
+        assert (l1 is None) != (l2 is None)  # exactly one winner
+        winner = "m1:7000" if l1 else "m2:7000"
+        assert c1.master_addr() == winner
+        # winner crashes -> key freed -> other can win
+        (c1 if l1 else c2).revoke(l1 or l2)
+        loser = c2 if l1 else c1
+        assert loser.elect_master("m3:7000") is not None
+        assert loser.master_addr() == "m3:7000"
+        c1.close(); c2.close()
