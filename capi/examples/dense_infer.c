@@ -1,0 +1,62 @@
+/* Dense inference from pure C (reference:
+ * paddle/capi/examples/model_inference/dense/main.c): load a model
+ * saved by paddle_tpu.io.save_inference_model, feed one batch, print
+ * the output row.
+ *
+ * Build (see tests/test_capi.py for the exact command):
+ *   g++ -o dense_infer dense_infer.c -L<repo>/capi -lpaddle_tpu_capi \
+ *       $(python3-config --embed --ldflags)
+ * Run:  ./dense_infer <model_dir> <dim>
+ */
+
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "../paddle_tpu_capi.h"
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <model_dir> <input_dim>\n", argv[0]);
+    return 2;
+  }
+  const char* model_dir = argv[1];
+  int dim = atoi(argv[2]);
+
+  if (pd_init(getenv("PADDLE_TPU_ROOT")) != 0) {
+    fprintf(stderr, "init failed: %s\n", pd_last_error());
+    return 1;
+  }
+  pd_machine machine;
+  if (pd_machine_create_for_inference(&machine, model_dir) != 0) {
+    fprintf(stderr, "create failed: %s\n", pd_last_error());
+    return 1;
+  }
+
+  float* in = (float*)malloc(sizeof(float) * dim);
+  for (int i = 0; i < dim; ++i) in[i] = (float)i / (float)dim;
+  int64_t dims[2] = {1, dim};
+  if (pd_machine_feed_f32(machine, "x", in, dims, 2) != 0 ||
+      pd_machine_forward(machine) != 0) {
+    fprintf(stderr, "forward failed: %s\n", pd_last_error());
+    return 1;
+  }
+
+  int64_t odims[8];
+  int ondim = 8;
+  pd_machine_output_dims(machine, 0, odims, &ondim);
+  int64_t n = 1;
+  for (int i = 0; i < ondim; ++i) n *= odims[i];
+  float* out = (float*)malloc(sizeof(float) * n);
+  if (pd_machine_output_f32(machine, 0, out, (uint64_t)n) != 0) {
+    fprintf(stderr, "fetch failed: %s\n", pd_last_error());
+    return 1;
+  }
+  printf("output:");
+  for (int64_t i = 0; i < n; ++i) printf(" %.6f", out[i]);
+  printf("\n");
+  pd_machine_destroy(machine);
+  free(in);
+  free(out);
+  return 0;
+}

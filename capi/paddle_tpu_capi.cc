@@ -1,0 +1,274 @@
+// Implementation of the pure-C inference API (see paddle_tpu_capi.h).
+//
+// Embeds CPython (reference precedent: paddle/utils/PythonUtil.h
+// embedded the interpreter inside the C++ trainer for
+// PyDataProvider2); every entry point grabs the GIL, calls into a tiny
+// Python-side shim class, and converts buffers at the boundary with
+// the CPython C API — no pybind11 (not in the image).
+
+#include "paddle_tpu_capi.h"
+
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::string g_last_error;
+PyObject* g_shim_class = nullptr;  // _CapiMachine
+
+struct Machine {
+  PyObject* obj;  // _CapiMachine instance
+};
+
+int Fail(const std::string& msg) {
+  g_last_error = msg;
+  return 1;
+}
+
+int FailFromPython() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      msg = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return Fail(msg);
+}
+
+// The Python-side shim: holds program/scope/executor, stages feeds,
+// runs forward.  Kept in Python because the executor API is Python;
+// kept *here* (not in the package) so the C library is self-contained
+// against any installed paddle_tpu.
+const char* kShim = R"PY(
+import os
+
+class _CapiMachine:
+    def __init__(self, model_dir):
+        import jax
+        if os.environ.get("JAX_PLATFORMS"):
+            try:
+                jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+            except Exception:
+                pass
+        import paddle_tpu as fluid
+
+        self._fluid = fluid
+        self._scope = fluid.executor.Scope()
+        self._exe = fluid.Executor(fluid.TPUPlace())
+        with fluid.executor.scope_guard(self._scope):
+            prog, feeds, fetches = fluid.io.load_inference_model(
+                model_dir, self._exe)
+        self._program, self._feed_names, self._fetch_names = prog, feeds, fetches
+        self._staged = {}
+        self._outputs = []
+
+    def feed(self, name, raw, dims, dtype):
+        import numpy as np
+        arr = np.frombuffer(raw, dtype=dtype).reshape(tuple(dims))
+        self._staged[name] = arr
+
+    def forward(self):
+        fluid = self._fluid
+        with fluid.executor.scope_guard(self._scope):
+            self._outputs = self._exe.run(
+                self._program, feed=dict(self._staged),
+                fetch_list=list(self._fetch_names))
+        self._staged = {}
+
+    def output_count(self):
+        return len(self._fetch_names)
+
+    def output_dims(self, i):
+        return list(self._outputs[i].shape)
+
+    def output_bytes(self, i):
+        import numpy as np
+        return np.ascontiguousarray(
+            np.asarray(self._outputs[i], dtype=np.float32)).tobytes()
+)PY";
+
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+PyObject* BuildDims(const int64_t* dims, int ndim) {
+  PyObject* t = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(t, i, PyLong_FromLongLong(dims[i]));
+  return t;
+}
+
+int64_t NumElements(const int64_t* dims, int ndim) {
+  int64_t n = 1;
+  for (int i = 0; i < ndim; ++i) n *= dims[i];
+  return n;
+}
+
+template <typename T>
+int FeedImpl(pd_machine machine, const char* name, const T* data,
+             const int64_t* dims, int ndim, const char* dtype) {
+  if (!machine) return Fail("null machine");
+  Gil gil;
+  int64_t n = NumElements(dims, ndim);
+  // zero-boxing marshalling: one bytes object, np.frombuffer on the
+  // Python side — the copy is memcpy-speed, not per-element
+  PyObject* raw = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data),
+      static_cast<Py_ssize_t>(n * sizeof(T)));
+  PyObject* pydims = BuildDims(dims, ndim);
+  PyObject* r = PyObject_CallMethod(static_cast<Machine*>(machine)->obj,
+                                    "feed", "sOOs", name, raw, pydims, dtype);
+  Py_DECREF(raw);
+  Py_DECREF(pydims);
+  if (!r) return FailFromPython();
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int pd_init(const char* repo_root) {
+  if (Py_IsInitialized()) {
+    if (!g_shim_class) return Fail("interpreter up but shim missing");
+    return 0;
+  }
+  Py_InitializeEx(0);
+  // Py_Initialize leaves this thread holding the GIL; do the setup
+  // directly under it (no Gil guard — PyEval_SaveThread below must be
+  // the matching release).
+  if (repo_root) {
+    PyObject* sys_path = PySys_GetObject("path");  // borrowed
+    PyObject* p = PyUnicode_FromString(repo_root);
+    PyList_Insert(sys_path, 0, p);
+    Py_DECREF(p);
+  }
+  PyObject* globals = PyDict_New();
+  PyDict_SetItemString(globals, "__builtins__", PyEval_GetBuiltins());
+  PyObject* r = PyRun_String(kShim, Py_file_input, globals, globals);
+  if (!r) {
+    int rc = FailFromPython();
+    Py_DECREF(globals);
+    return rc;
+  }
+  Py_DECREF(r);
+  g_shim_class = PyDict_GetItemString(globals, "_CapiMachine");  // borrowed
+  Py_XINCREF(g_shim_class);
+  Py_DECREF(globals);
+  if (!g_shim_class) return Fail("shim class missing");
+  // release the GIL acquired implicitly by Py_Initialize on this thread
+  // so later Gil guards can re-acquire from any thread
+  PyEval_SaveThread();
+  return 0;
+}
+
+int pd_machine_create_for_inference(pd_machine* machine,
+                                    const char* model_dir) {
+  if (!g_shim_class) return Fail("pd_init not called");
+  Gil gil;
+  PyObject* obj = PyObject_CallFunction(g_shim_class, "s", model_dir);
+  if (!obj) return FailFromPython();
+  auto* m = new Machine();
+  m->obj = obj;
+  *machine = m;
+  return 0;
+}
+
+int pd_machine_feed_f32(pd_machine machine, const char* name,
+                        const float* data, const int64_t* dims, int ndim) {
+  return FeedImpl(machine, name, data, dims, ndim, "float32");
+}
+
+int pd_machine_feed_i64(pd_machine machine, const char* name,
+                        const int64_t* data, const int64_t* dims, int ndim) {
+  return FeedImpl(machine, name, data, dims, ndim, "int64");
+}
+
+int pd_machine_forward(pd_machine machine) {
+  if (!machine) return Fail("null machine");
+  Gil gil;
+  PyObject* r =
+      PyObject_CallMethod(static_cast<Machine*>(machine)->obj, "forward", "");
+  if (!r) return FailFromPython();
+  Py_DECREF(r);
+  return 0;
+}
+
+int pd_machine_output_count(pd_machine machine) {
+  if (!machine) return -1;
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(static_cast<Machine*>(machine)->obj,
+                                    "output_count", "");
+  if (!r) { FailFromPython(); return -1; }
+  long n = PyLong_AsLong(r);
+  Py_DECREF(r);
+  return static_cast<int>(n);
+}
+
+int pd_machine_output_dims(pd_machine machine, int i, int64_t* dims,
+                           int* ndim) {
+  if (!machine) return Fail("null machine");
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(static_cast<Machine*>(machine)->obj,
+                                    "output_dims", "i", i);
+  if (!r) return FailFromPython();
+  int n = static_cast<int>(PyList_Size(r));
+  for (int k = 0; k < n && k < *ndim; ++k)
+    dims[k] = PyLong_AsLongLong(PyList_GetItem(r, k));
+  *ndim = n;
+  Py_DECREF(r);
+  return 0;
+}
+
+int pd_machine_output_f32(pd_machine machine, int i, float* buf,
+                          uint64_t cap) {
+  if (!machine) return Fail("null machine");
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(static_cast<Machine*>(machine)->obj,
+                                    "output_bytes", "i", i);
+  if (!r) return FailFromPython();
+  char* data = nullptr;
+  Py_ssize_t nbytes = 0;
+  if (PyBytes_AsStringAndSize(r, &data, &nbytes) != 0) {
+    Py_DECREF(r);
+    return FailFromPython();
+  }
+  if (static_cast<uint64_t>(nbytes) > cap * sizeof(float)) {
+    Py_DECREF(r);
+    return Fail("output buffer too small");
+  }
+  std::memcpy(buf, data, static_cast<size_t>(nbytes));
+  Py_DECREF(r);
+  return 0;
+}
+
+void pd_machine_destroy(pd_machine machine) {
+  if (!machine) return;
+  auto* m = static_cast<Machine*>(machine);
+  {
+    Gil gil;
+    Py_XDECREF(m->obj);
+  }
+  delete m;
+}
+
+const char* pd_last_error(void) { return g_last_error.c_str(); }
+
+}  // extern "C"

@@ -1,0 +1,75 @@
+/* Pure-C inference API.
+ *
+ * C rebuild of the reference's capi (reference:
+ * paddle/capi/gradient_machine.h:36-73
+ * paddle_gradient_machine_create_for_inference_with_parameters /
+ * _forward; paddle/capi/main.h:27 paddle_init).  The reference bound C
+ * to the legacy C++ GradientMachine; the TPU-native equivalent binds C
+ * to the compiling executor through an embedded CPython, so a C/C++
+ * application can run a model saved with
+ * paddle_tpu.io.save_inference_model with no Python code of its own.
+ * The heavy lifting (XLA compile, TPU execution) happens exactly as in
+ * the Python path; the embedded interpreter is control plane only,
+ * mirroring how the reference embedded Python for PyDataProvider2
+ * (paddle/utils/PythonUtil.h).
+ *
+ * Thread-safety: calls are serialized on the embedded interpreter's
+ * GIL.  All functions return 0 on success, nonzero on error
+ * (pd_last_error() gives the message, like paddle_error +
+ * paddle_error_string).
+ */
+
+#ifndef PADDLE_TPU_CAPI_H
+#define PADDLE_TPU_CAPI_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* pd_machine;
+
+/* Initialize the runtime (starts the embedded interpreter once per
+ * process; repo_root = directory containing paddle_tpu/, or NULL to
+ * rely on PYTHONPATH).  Mirrors paddle_init (capi/main.h:27). */
+int pd_init(const char* repo_root);
+
+/* Create an inference machine from a save_inference_model directory.
+ * Mirrors paddle_gradient_machine_create_for_inference_with_parameters
+ * (capi/gradient_machine.h:52): config + parameters in one artifact. */
+int pd_machine_create_for_inference(pd_machine* machine,
+                                    const char* model_dir);
+
+/* Stage one named input (row-major, f32 or i64). */
+int pd_machine_feed_f32(pd_machine machine, const char* name,
+                        const float* data, const int64_t* dims, int ndim);
+int pd_machine_feed_i64(pd_machine machine, const char* name,
+                        const int64_t* data, const int64_t* dims, int ndim);
+
+/* Run the pruned inference program over the staged feeds.
+ * Mirrors paddle_gradient_machine_forward (capi/gradient_machine.h:73). */
+int pd_machine_forward(pd_machine machine);
+
+/* Number of fetch targets. */
+int pd_machine_output_count(pd_machine machine);
+
+/* Shape of output i after forward: writes up to *ndim dims, sets *ndim. */
+int pd_machine_output_dims(pd_machine machine, int i, int64_t* dims,
+                           int* ndim);
+
+/* Copy output i (as f32) into buf (capacity in elements). */
+int pd_machine_output_f32(pd_machine machine, int i, float* buf,
+                          uint64_t cap);
+
+void pd_machine_destroy(pd_machine machine);
+
+/* Last error message (thread-local not guaranteed; single-threaded use
+ * or external locking recommended, as with the reference capi). */
+const char* pd_last_error(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PADDLE_TPU_CAPI_H */

@@ -344,6 +344,7 @@ Optimizer* opt_deserialize(const uint8_t* buf, uint64_t len) {
   uint64_t step;
   if (!GetU64(&p, end, &step)) return nullptr;
   if (!GetU64(&p, end, &n) || static_cast<uint64_t>(end - p) < n) return nullptr;
+  if (n % 4 != 0) return nullptr;  // f32-aligned weights only
   auto* o = new Optimizer();
   o->cfg_str = cfg;
   o->cfg = ParseConfig(cfg);
@@ -359,6 +360,9 @@ Optimizer* opt_deserialize(const uint8_t* buf, uint64_t len) {
     std::string name(reinterpret_cast<const char*>(p), ln);
     p += ln;
     if (!GetU64(&p, end, &ln) || static_cast<uint64_t>(end - p) < ln) { delete o; return nullptr; }
+    // state buffers must be exactly weight-sized f32 arrays — Update*
+    // indexes them by weight offset, so a short buffer would be OOB
+    if (ln != o->weights.size() * 4) { delete o; return nullptr; }
     std::vector<float> vals(ln / 4);
     std::memcpy(vals.data(), p, ln);
     p += ln;

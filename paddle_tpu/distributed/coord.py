@@ -134,14 +134,15 @@ class CoordClient:
         go/pserver/etcd_client.go:170).  Returns (index, lease_id)."""
         lease_id = self.lease(ttl_sec)
         while True:
+            # the claim lease must outlive the contention wait
+            try:
+                self.keepalive(lease_id)
+            except RuntimeError:
+                lease_id = self.lease(ttl_sec)
             for idx in range(num_pservers):
                 key = f"{self.PSERVER_PREFIX}{idx}"
                 if self.cas(key, None, addr.encode(), lease=lease_id):
                     return idx, lease_id
-                cur = self.get(key)
-                # dead pserver's lease expired between GET and CAS: retry
-                if cur is None:
-                    continue
             time.sleep(0.2)
 
     def pserver_addrs(self, num_pservers: int):

@@ -432,6 +432,12 @@ class Program:
                 for op in block.ops:
                     if "is_test" in _ops_with_is_test(op.type):
                         op.attrs["is_test"] = True
+                # Strip training-only ops (reference: fluid clone(for_test)
+                # drops backward/optimize-role ops): grad ops, parameter
+                # updates, and the LR-scheduler step counter.  Without
+                # this a test-program run would keep TRAINING the model.
+                block.ops = [op for op in block.ops
+                             if not _is_training_only_op(op)]
         return p
 
     def invalidate_cache(self):
@@ -459,6 +465,31 @@ class Program:
 
 def _ops_with_is_test(op_type: str):
     return {"dropout": ("is_test",), "batch_norm": ("is_test",)}.get(op_type, ())
+
+
+# Parameter-update op types (reference: fluid optimizer.py appends these;
+# clone(for_test) must drop them so test runs don't train).
+_OPTIMIZER_OP_TYPES = frozenset({
+    "sgd", "momentum", "adam", "adamax", "adagrad", "decayed_adagrad",
+    "adadelta", "rmsprop", "ftrl", "proximal_gd", "proximal_adagrad",
+})
+
+
+def _is_training_only_op(op) -> bool:
+    # primary signal: the role stamped by Optimizer._create_optimization_pass
+    if op.attrs.get("op_role") == "optimize":
+        return True
+    # fallbacks for hand-built programs that skip the optimizer classes
+    if op.type in _OPTIMIZER_OP_TYPES:
+        return True
+    if any("@GRAD" in name for name in op.output_arg_names):
+        return True
+    # LR-scheduler global-step bump (lr_scheduler.py _counter): in-place
+    # increment of the persistable step var
+    if op.type == "increment" and any(
+            "@lr_global_step@" in n for n in op.output_arg_names):
+        return True
+    return False
 
 
 # ---------------------------------------------------------------------------

@@ -32,6 +32,8 @@ __all__ = [
     "multi_head_attention",
     "lstm_unit",
     "gru_unit",
+    "linear_chain_crf",
+    "crf_decoding",
 ]
 
 
@@ -55,7 +57,16 @@ def fc(
     mul_results = []
     for inp in _to_list(input):
         in_shape = inp.shape
+        if in_shape is None:
+            raise ValueError(
+                f"fc input {inp.name!r} has no inferred shape; the weight "
+                "shape must be static")
         lead = in_shape[num_flatten_dims:]
+        if any(s is None or s < 0 for s in lead):
+            raise ValueError(
+                f"fc input {inp.name!r} has unknown feature dims "
+                f"{tuple(lead)} past num_flatten_dims={num_flatten_dims}; "
+                "the weight shape must be static")
         in_features = 1
         for s in lead:
             in_features *= s
@@ -427,7 +438,21 @@ def accuracy(input, label, k: int = 1, **kwargs):
 
 def matmul(x, y, transpose_x=False, transpose_y=False, **kwargs):
     helper = LayerHelper("matmul", **kwargs)
-    out = helper.create_tmp_variable(x.dtype)
+    shape = None
+    if x.shape is not None and y.shape is not None:
+        xs, ys = list(x.shape), list(y.shape)
+        if len(xs) >= 2 and transpose_x:
+            xs[-1], xs[-2] = xs[-2], xs[-1]
+        if len(ys) >= 2 and transpose_y:
+            ys[-1], ys[-2] = ys[-2], ys[-1]
+        if len(xs) >= 2 and len(ys) >= 2:
+            batch = xs[:-2] if len(xs) >= len(ys) else ys[:-2]
+            shape = tuple(batch) + (xs[-2], ys[-1])
+        elif len(xs) == 1 and len(ys) >= 2:
+            shape = tuple(ys[:-2]) + (ys[-1],)
+        elif len(xs) >= 2 and len(ys) == 1:
+            shape = tuple(xs[:-1])
+    out = helper.create_tmp_variable(x.dtype, shape)
     helper.append_op(
         type="matmul", inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]},
         attrs={"transpose_X": transpose_x, "transpose_Y": transpose_y},
@@ -527,3 +552,49 @@ def gru_unit(input, hidden, size: int, param_attr=None, bias_attr=None,
                               "Hidden": [out]},
                      attrs={"activation": activation})
     return out, rhp, gate
+
+
+def linear_chain_crf(input, label, length=None, param_attr=None, **kwargs):
+    """Linear-chain CRF negative log-likelihood over padded emissions
+    (B, T, D) with per-sequence lengths.  Reference API:
+    fluid/layers/nn.py linear_chain_crf → operators/linear_chain_crf_op.cc;
+    the transition parameter rows are [start; end; pairwise(D, D)].
+    Returns the per-sequence cost (B, 1); the transition parameter is
+    named via ``param_attr`` so crf_decoding can share it."""
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr, **kwargs)
+    num_tags = input.shape[-1]
+    transition = helper.create_parameter(
+        helper.param_attr, shape=[2 + num_tags, num_tags], dtype=input.dtype)
+    batch = input.shape[0]
+    ll = helper.create_tmp_variable(input.dtype, (batch, 1))
+    alpha = helper.create_tmp_variable(input.dtype, (batch, num_tags))
+    eexp = helper.create_tmp_variable(input.dtype, input.shape)
+    texp = helper.create_tmp_variable(input.dtype, (2 + num_tags, num_tags))
+    inputs = {"Emission": [input], "Transition": [transition],
+              "Label": [label]}
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(
+        type="linear_chain_crf", inputs=inputs,
+        outputs={"LogLikelihood": [ll], "Alpha": [alpha],
+                 "EmissionExps": [eexp], "TransitionExps": [texp]})
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None, length=None, **kwargs):
+    """Viterbi decode with the transition parameter learned by
+    linear_chain_crf (reference: fluid/layers/nn.py crf_decoding →
+    operators/crf_decoding_op.cc).  Returns the (B, T) best tag path."""
+    helper = LayerHelper("crf_decoding", param_attr=param_attr, **kwargs)
+    num_tags = input.shape[-1]
+    transition = helper.create_parameter(
+        helper.param_attr, shape=[2 + num_tags, num_tags], dtype=input.dtype)
+    path = helper.create_tmp_variable("int64", input.shape[:-1])
+    inputs = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        inputs["Label"] = [label]
+    if length is not None:
+        inputs["Length"] = [length]
+    helper.append_op(type="crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": [path]})
+    return path

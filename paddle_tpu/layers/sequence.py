@@ -56,6 +56,26 @@ def sequence_expand(x, y, **kwargs):
 
 def sequence_conv(input, num_filters, filter_size=3, act=None, param_attr=None,
                   bias_attr=None, **kwargs):
-    """Context-window conv over packed sequence rows.  TODO: LoD-aware
-    boundary masking (currently plain context projection)."""
-    raise NotImplementedError("sequence_conv lands with the NMT milestone")
+    """Context-window conv over sequence rows (reference:
+    operators/sequence_conv_op.cc = context projection + gemm;
+    gserver ContextProjection + fc).  input (B, T, D) ->
+    (B, T, num_filters): window-concat via the context_project op, then
+    a position-wise fc — the window concat is pure shifts, so XLA fuses
+    it into the projection matmul (MXU-friendly, no im2col buffer)."""
+    from paddle_tpu.layer_helper import LayerHelper
+    from paddle_tpu.layers.nn import fc
+
+    helper = LayerHelper("sequence_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, **kwargs)
+    B, T, D = input.shape
+    expanded = helper.create_tmp_variable(input.dtype,
+                                          (B, T, D * filter_size))
+    helper.append_op(
+        type="context_project",
+        inputs={"X": [input]},
+        outputs={"Out": [expanded]},
+        attrs={"context_length": int(filter_size),
+               "context_start": -(int(filter_size) // 2)},
+    )
+    return fc(expanded, num_filters, num_flatten_dims=2,
+              param_attr=param_attr, bias_attr=bias_attr, act=act, **kwargs)

@@ -94,6 +94,7 @@ class Optimizer:
     def _create_optimization_pass(self, params_grads, loss: Variable):
         block = loss.block.program.global_block()
         self._main_block = block
+        n_before = len(block.ops)
         if self.grad_clip is not None:
             params_grads = self.grad_clip.append_clip_ops(block, params_grads)
         self._create_lr_var(block)
@@ -109,6 +110,12 @@ class Optimizer:
                 type="increment", inputs={"X": [self._global_step]},
                 outputs={"Out": [self._global_step]}, attrs={"step": 1.0},
             )
+        # Role-mark everything this pass appended (clip, lr, updates,
+        # beta-pow bumps, global step) so clone(for_test) can strip the
+        # whole update machinery, not just the headline update ops
+        # (reference: fluid's op_role=Optimize attribute).
+        for op in block.ops[n_before:]:
+            op.attrs["op_role"] = "optimize"
         return ops
 
 

@@ -12,14 +12,17 @@ dropping them into the cache dir."""
 from paddle_tpu.v2.dataset import (
     cifar,
     conll05,
+    flowers,
     imdb,
     imikolov,
     mnist,
     movielens,
+    mq2007,
     sentiment,
     uci_housing,
+    voc2012,
     wmt14,
 )
 
 __all__ = ["mnist", "cifar", "imdb", "imikolov", "movielens", "uci_housing",
-           "conll05", "sentiment", "wmt14"]
+           "conll05", "sentiment", "wmt14", "flowers", "mq2007", "voc2012"]

@@ -1,6 +1,7 @@
 """Dataset helpers: cache dir + synthetic corpus RNG."""
 
 import os
+import zlib
 
 import numpy as np
 
@@ -16,4 +17,6 @@ def has_cache(*parts):
 
 
 def synth_rng(name: str, split: str):
-    return np.random.RandomState(abs(hash((name, split))) % (2 ** 31))
+    # crc32, not hash(): Python randomizes str hashes per process, and
+    # the synthetic corpora must be identical across processes/runs
+    return np.random.RandomState(zlib.crc32(f"{name}/{split}".encode()))

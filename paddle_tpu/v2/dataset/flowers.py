@@ -1,0 +1,38 @@
+"""Oxford 102 Flowers (reference: python/paddle/v2/dataset/flowers.py).
+Records: (float32[3*32*32] image in [0,1], label in [0,102)).
+
+The reference streamed resized JPEG batches from the official tarballs;
+this environment has no egress, so readers serve a deterministic
+synthetic corpus with the same record contract (class-conditional
+images, stable across runs via common.synth_rng)."""
+
+import numpy as np
+
+from paddle_tpu.v2.dataset import common
+
+CLASS_NUM = 102
+_DIM = 3 * 32 * 32
+
+
+def _synth(split, n):
+    def reader():
+        rng = common.synth_rng("flowers", split)
+        protos = rng.rand(CLASS_NUM, _DIM).astype(np.float32)
+        for _ in range(n):
+            y = int(rng.randint(0, CLASS_NUM))
+            x = np.clip(protos[y] + 0.1 * rng.randn(_DIM), 0, 1)
+            yield (x.astype(np.float32), y)
+
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=False):
+    return _synth("train", 6144)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=False):
+    return _synth("test", 1024)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=False):
+    return _synth("valid", 1024)

@@ -1,0 +1,64 @@
+"""MQ2007 LETOR learning-to-rank (reference:
+python/paddle/v2/dataset/mq2007.py).
+
+Record formats match the reference's three modes:
+  - ``pointwise``: (feature float32[46], relevance float)
+  - ``pairwise``: (query_left float32[46], query_right float32[46]) with
+    left more relevant than right
+  - ``listwise``: (label list, feature-list) per query
+
+No egress: a deterministic synthetic corpus with query-grouped records
+(same schema, 46 LETOR features, graded relevance 0-2)."""
+
+import numpy as np
+
+from paddle_tpu.v2.dataset import common
+
+FEATURE_DIM = 46
+
+
+def _queries(split, n_queries, docs_per_query):
+    rng = common.synth_rng("mq2007", split)
+    out = []
+    for _ in range(n_queries):
+        qvec = rng.randn(FEATURE_DIM).astype(np.float32)
+        docs = []
+        for _ in range(docs_per_query):
+            x = (qvec + rng.randn(FEATURE_DIM)).astype(np.float32)
+            # relevance correlates with projection on the query direction
+            score = float(x @ qvec) / FEATURE_DIM
+            rel = 2 if score > 0.5 else (1 if score > 0.0 else 0)
+            docs.append((rel, x))
+        out.append(docs)
+    return out
+
+
+def _reader(split, fmt, n_queries=200, docs_per_query=8):
+    def pointwise():
+        for docs in _queries(split, n_queries, docs_per_query):
+            for rel, x in docs:
+                yield (x, float(rel))
+
+    def pairwise():
+        for docs in _queries(split, n_queries, docs_per_query):
+            for i, (ri, xi) in enumerate(docs):
+                for rj, xj in docs[i + 1:]:
+                    if ri > rj:
+                        yield (xi, xj)
+                    elif rj > ri:
+                        yield (xj, xi)
+
+    def listwise():
+        for docs in _queries(split, n_queries, docs_per_query):
+            yield ([float(r) for r, _ in docs], [x for _, x in docs])
+
+    return {"pointwise": pointwise, "pairwise": pairwise,
+            "listwise": listwise}[fmt]
+
+
+def train(format="pairwise"):
+    return _reader("train", format)
+
+
+def test(format="pairwise"):
+    return _reader("test", format, n_queries=40)

@@ -1,0 +1,50 @@
+"""PASCAL VOC2012 segmentation (reference:
+python/paddle/v2/dataset/voc2012.py).  Records: (float32[3,H,W] image in
+[0,1], int32[H,W] label mask with values in [0,21) or 255=ignore).
+
+No egress: deterministic synthetic scenes — a background plus a few
+axis-aligned object rectangles whose class paints both the image hue
+and the mask, preserving the image/mask alignment contract real
+consumers rely on."""
+
+import numpy as np
+
+from paddle_tpu.v2.dataset import common
+
+CLASS_NUM = 21  # 20 objects + background
+IGNORE_LABEL = 255
+_H = _W = 64
+
+
+def _synth(split, n):
+    def reader():
+        rng = common.synth_rng("voc2012", split)
+        palette = rng.rand(CLASS_NUM, 3).astype(np.float32)
+        for _ in range(n):
+            img = np.tile(palette[0].reshape(3, 1, 1), (1, _H, _W))
+            mask = np.zeros((_H, _W), np.int32)
+            for _ in range(int(rng.randint(1, 4))):
+                cls = int(rng.randint(1, CLASS_NUM))
+                h0, w0 = rng.randint(0, _H - 8), rng.randint(0, _W - 8)
+                h1 = h0 + rng.randint(8, _H - h0 + 1)
+                w1 = w0 + rng.randint(8, _W - w0 + 1)
+                img[:, h0:h1, w0:w1] = palette[cls].reshape(3, 1, 1)
+                mask[h0:h1, w0:w1] = cls
+                # thin ignore border, as in real VOC annotations
+                mask[h0, w0:w1] = IGNORE_LABEL
+            noise = 0.05 * rng.randn(3, _H, _W)
+            yield (np.clip(img + noise, 0, 1).astype(np.float32), mask)
+
+    return reader
+
+
+def train():
+    return _synth("train", 1464)
+
+
+def test():
+    return _synth("test", 512)
+
+
+def val():
+    return _synth("val", 512)

@@ -1,0 +1,270 @@
+"""Book acceptance tests, wave 2 (reference: fluid/tests/book/ —
+test_understand_sentiment_conv.py, test_label_semantic_roles.py,
+test_recommender_system.py, test_machine_translation.py): real model
+topologies trained end-to-end on synthetic-but-learnable corpora with
+convergence exit criteria, mirroring the reference's convergence-based
+book tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    fluid.framework.reset_default_programs()
+    yield
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(7)
+
+
+def test_understand_sentiment_conv(rng):
+    """Sequence conv + max-pool text classifier (reference:
+    book/test_understand_sentiment_conv.py convolution_net)."""
+    vocab, T, emb_dim, classes = 30, 16, 16, 2
+    ids = fluid.layers.data(name="ids", shape=[T, 1], dtype="int64")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    emb = fluid.layers.embedding(ids, size=[vocab, emb_dim])
+    conv = fluid.layers.sequence_conv(emb, num_filters=32, filter_size=3,
+                                      act="tanh")
+    pooled = fluid.layers.reduce_max(conv, dim=1)  # max-pool over time
+    pred = fluid.layers.fc(input=pooled, size=classes, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(input=pred, label=label))
+    acc = fluid.layers.accuracy(input=pred, label=label)
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    # sentiment = whether "positive word" (id<5) outnumbers "negative"
+    # (id>=25); others neutral filler
+    a = 0.0
+    for _ in range(60):
+        xs = rng.randint(5, 25, (64, T))
+        for r in range(64):
+            npos, nneg = rng.randint(0, 4), rng.randint(0, 4)
+            xs[r, :npos] = rng.randint(0, 5, npos)
+            xs[r, npos:npos + nneg] = rng.randint(25, 30, nneg)
+        ys = (np.sum(xs < 5, 1) > np.sum(xs >= 25, 1)).astype(np.int64)
+        _, a = exe.run(feed={"ids": xs.astype(np.int64)[:, :, None],
+                             "label": ys.reshape(-1, 1)},
+                       fetch_list=[loss, acc])
+    assert float(a) > 0.85, float(a)
+
+
+def test_label_semantic_roles_crf(rng):
+    """Tagging with a linear-chain CRF head (reference:
+    book/test_label_semantic_roles.py: emission fc → linear_chain_crf
+    cost, crf_decoding for eval)."""
+    vocab, T, emb_dim, tags = 20, 10, 16, 4
+    ids = fluid.layers.data(name="ids", shape=[T, 1], dtype="int64")
+    tag = fluid.layers.data(name="tag", shape=[T], dtype="int64")
+    length = fluid.layers.data(name="len", shape=[1], dtype="int64")
+    emb = fluid.layers.embedding(ids, size=[vocab, emb_dim])
+    emission = fluid.layers.fc(input=emb, size=tags, num_flatten_dims=2)
+    crf_cost = fluid.layers.linear_chain_crf(
+        emission, tag, length=length,
+        param_attr=fluid.ParamAttr(name="crf_w"))
+    avg = fluid.layers.mean(crf_cost)
+    decode = fluid.layers.crf_decoding(
+        emission, param_attr=fluid.ParamAttr(name="crf_w"), length=length)
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(avg)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    def batch(n=32):
+        xs = rng.randint(0, vocab, (n, T))
+        # tag depends on word bucket + forced transition structure:
+        # tag 3 only ever follows tag 2 (CRF can exploit transitions)
+        base = (xs // 5).astype(np.int64)
+        for r in range(n):
+            for t in range(1, T):
+                if base[r, t - 1] == 2 and base[r, t] == 3:
+                    pass
+                elif base[r, t] == 3:
+                    base[r, t] = 1
+        lens = np.full((n, 1), T, np.int64)
+        return xs.astype(np.int64), base, lens
+
+    first = last = None
+    for _ in range(80):
+        xs, ys, lens = batch()
+        (l,) = exe.run(feed={"ids": xs[:, :, None], "tag": ys, "len": lens},
+                       fetch_list=[avg])
+        first = first if first is not None else float(l)
+        last = float(l)
+    assert last < 0.3 * first, (first, last)
+    xs, ys, lens = batch(64)
+    (path,) = exe.run(feed={"ids": xs[:, :, None], "tag": ys, "len": lens},
+                      fetch_list=[decode])
+    acc = float((np.asarray(path) == ys).mean())
+    assert acc > 0.9, acc
+
+
+def test_recommender_system(rng):
+    """Dual-embedding rating regressor (reference:
+    book/test_recommender_system.py: usr/mov features → cos_sim →
+    square-error; here the dense-feature core of it)."""
+    n_users, n_movies, dim = 40, 30, 8
+    uid = fluid.layers.data(name="uid", shape=[1], dtype="int64")
+    mid = fluid.layers.data(name="mid", shape=[1], dtype="int64")
+    rating = fluid.layers.data(name="rating", shape=[1], dtype="float32")
+    uemb = fluid.layers.fc(input=fluid.layers.embedding(uid, [n_users, dim]),
+                           size=dim, act="tanh")
+    memb = fluid.layers.fc(input=fluid.layers.embedding(mid, [n_movies, dim]),
+                           size=dim, act="tanh")
+    inter = fluid.layers.elementwise_mul(uemb, memb)
+    concat = fluid.layers.concat([uemb, memb, inter], axis=1)
+    pred = fluid.layers.fc(input=concat, size=1)
+    loss = fluid.layers.mean(
+        fluid.layers.square_error_cost(input=pred, label=rating))
+    fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    # ground truth: low-rank preference matrix
+    U = rng.randn(n_users, 3)
+    M = rng.randn(n_movies, 3)
+    R = (U @ M.T) / 3.0
+    first = last = None
+    for _ in range(150):
+        us = rng.randint(0, n_users, (64, 1))
+        ms = rng.randint(0, n_movies, (64, 1))
+        rs = R[us[:, 0], ms[:, 0]].astype(np.float32).reshape(-1, 1)
+        (l,) = exe.run(feed={"uid": us.astype(np.int64),
+                             "mid": ms.astype(np.int64), "rating": rs},
+                       fetch_list=[loss])
+        first = first if first is not None else float(l)
+        last = float(l)
+    assert last < 0.25 * first, (first, last)
+
+
+def _build_seq2seq(vocab, Ts, Td, emb_dim, hid):
+    """Encoder-decoder with Luong-style attention, teacher forced:
+    encoder LSTM over source; decoder LSTM over shifted target; per-step
+    context = softmax(dec_h @ enc_h^T) @ enc_h; concat -> vocab softmax.
+    Reference: book/test_machine_translation.py seq_to_seq_net (additive
+    attention over encoder states); same capability, MXU-friendly
+    batched-matmul form instead of per-step RNN-group plumbing."""
+    src = fluid.layers.data(name="src", shape=[Ts, 1], dtype="int64")
+    tin = fluid.layers.data(name="tin", shape=[Td, 1], dtype="int64")
+    tout = fluid.layers.data(name="tout", shape=[Td], dtype="int64")
+    semb = fluid.layers.embedding(src, size=[vocab, emb_dim],
+                                  param_attr=fluid.ParamAttr(name="src_emb"))
+    sproj = fluid.layers.fc(input=semb, size=4 * hid, num_flatten_dims=2,
+                            bias_attr=False)
+    enc_h, _ = fluid.layers.lstm(sproj, size=hid)          # (B, Ts, H)
+    demb = fluid.layers.embedding(tin, size=[vocab, emb_dim],
+                                  param_attr=fluid.ParamAttr(name="tgt_emb"))
+    dproj = fluid.layers.fc(input=demb, size=4 * hid, num_flatten_dims=2,
+                            bias_attr=False)
+    dec_h, _ = fluid.layers.lstm(dproj, size=hid)          # (B, Td, H)
+    scores = fluid.layers.matmul(dec_h, enc_h, transpose_y=True)  # (B,Td,Ts)
+    attn = fluid.layers.softmax(scores)
+    ctx = fluid.layers.matmul(attn, enc_h)                  # (B, Td, H)
+    both = fluid.layers.concat([dec_h, ctx], axis=2)
+    logits = fluid.layers.fc(input=both, size=vocab, num_flatten_dims=2)
+    loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+        fluid.layers.reshape(logits, [-1, vocab]),
+        fluid.layers.reshape(tout, [-1, 1])))
+    pred_ids = fluid.layers.topk(fluid.layers.reshape(logits, [-1, vocab]),
+                                 k=1)[1]
+    return loss, pred_ids
+
+
+def test_machine_translation_attention(rng):
+    """Seq2seq with attention learns to 'translate' (reverse + shift)
+    and greedy decoding reproduces the target (reference:
+    book/test_machine_translation.py train + decode halves)."""
+    vocab, Ts, emb_dim, hid = 16, 6, 24, 32
+    Td = Ts
+    BOS = 0
+    loss, pred_ids = _build_seq2seq(vocab, Ts, Td, emb_dim, hid)
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    test_prog = fluid.default_main_program().clone(for_test=True)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    def make_batch(n=64):
+        xs = rng.randint(2, vocab, (n, Ts)).astype(np.int64)
+        tgt = ((xs[:, ::-1] + 1 - 2) % (vocab - 2)) + 2   # reverse + shift
+        tin = np.concatenate([np.full((n, 1), BOS, np.int64), tgt[:, :-1]], 1)
+        return xs, tin, tgt
+
+    first = last = None
+    for _ in range(400):
+        xs, tin, tout = make_batch()
+        (l,) = exe.run(feed={"src": xs[:, :, None], "tin": tin[:, :, None], "tout": tout},
+                       fetch_list=[loss])
+        first = first if first is not None else float(l)
+        last = float(l)
+    assert last < 0.1 * first, (first, last)
+
+    # greedy decode: grow the target prefix token by token (static
+    # shapes: full padded prefix each step, read position t)
+    xs, _, tout = make_batch(16)
+    prefix = np.full((16, Td), BOS, np.int64)
+    for t in range(Td):
+        (ids,) = exe.run(test_prog,
+                         feed={"src": xs[:, :, None], "tin": prefix[:, :, None],
+                               "tout": np.zeros_like(prefix)},
+                         fetch_list=[pred_ids])
+        step = np.asarray(ids).reshape(16, Td)[:, t]
+        if t + 1 < Td:
+            prefix[:, t + 1] = step
+        final = step if t == Td - 1 else None
+    decoded = np.concatenate([prefix[:, 1:], np.asarray(final).reshape(-1, 1)], 1)
+    acc = float((decoded == tout).mean())
+    assert acc > 0.85, acc
+
+
+def test_clone_for_test_does_not_train(rng):
+    """A for_test clone must strip grad/optimizer/lr-step ops: running
+    it repeatedly leaves parameters untouched (reference: fluid
+    Program.clone(for_test) drops backward/optimize-role ops)."""
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.Adam(learning_rate=0.1).minimize(loss)
+    test_prog = fluid.default_main_program().clone(for_test=True)
+    assert not any(op.type == "adam" or
+                   any("@GRAD" in n for n in op.output_arg_names)
+                   for op in test_prog.global_block().ops)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = fluid.global_scope()
+    pname = fluid.default_main_program().all_parameters()[0].name
+    w0 = np.array(scope.get(pname))
+    feed = {"x": rng.randn(8, 4).astype("float32"),
+            "y": rng.randn(8, 1).astype("float32")}
+    for _ in range(3):
+        exe.run(test_prog, feed=feed, fetch_list=[loss])
+    np.testing.assert_array_equal(np.array(scope.get(pname)), w0)
+    # the train program still trains
+    exe.run(feed=feed, fetch_list=[loss])
+    assert np.abs(np.array(scope.get(pname)) - w0).max() > 0
+
+
+def test_googlenet_forward_and_train_step(rng):
+    """GoogLeNet builds, forwards, and takes one training step at small
+    resolution (reference: benchmark/paddle/image/googlenet.py)."""
+    from paddle_tpu.models import googlenet
+
+    img = fluid.layers.data(name="img", shape=[3, 224, 224], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    pred = googlenet(img, class_dim=10)
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(input=pred, label=label))
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs = rng.randn(2, 3, 224, 224).astype("float32")
+    ys = rng.randint(0, 10, (2, 1)).astype("int64")
+    (l,) = exe.run(feed={"img": xs, "label": ys}, fetch_list=[loss])
+    assert np.isfinite(float(l))

@@ -1,0 +1,84 @@
+"""C inference API tests: build libpaddle_tpu_capi.so + the example C
+program with g++, save an inference model from Python, run the C binary
+in a subprocess, and check its output matches in-process inference.
+
+Reference model: paddle/capi/examples/model_inference/dense +
+capi/tests/test_GradientMachine.cpp (same create→feed→forward→fetch
+contract, exercised from outside Python).
+"""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CAPI = os.path.join(REPO, "capi")
+
+
+def _pyconfig(*args):
+    out = subprocess.run(["python3-config", *args], capture_output=True,
+                         text=True, check=True)
+    return out.stdout.split()
+
+
+@pytest.fixture(scope="module")
+def capi_binary(tmp_path_factory):
+    d = tmp_path_factory.mktemp("capi")
+    lib = os.path.join(str(d), "libpaddle_tpu_capi.so")
+    exe = os.path.join(str(d), "dense_infer")
+    includes = _pyconfig("--includes")
+    ldflags = _pyconfig("--embed", "--ldflags")
+    subprocess.run(
+        ["g++", "-std=c++17", "-O2", "-shared", "-fPIC",
+         os.path.join(CAPI, "paddle_tpu_capi.cc"), "-o", lib,
+         *includes, *ldflags], check=True, capture_output=True)
+    subprocess.run(
+        ["g++", "-O2", os.path.join(CAPI, "examples", "dense_infer.c"),
+         "-o", exe, "-I", CAPI, lib, *ldflags,
+         f"-Wl,-rpath,{d}"], check=True, capture_output=True)
+    return exe
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    """Save a small fc+softmax inference model and its expected output."""
+    import paddle_tpu as fluid
+
+    fluid.framework.reset_default_programs()
+    dim, nclass = 8, 4
+    x = fluid.layers.data(name="x", shape=[dim], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=nclass, act="softmax")
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(fluid.default_startup_program())
+    d = str(tmp_path_factory.mktemp("model"))
+    fluid.io.save_inference_model(d, ["x"], [pred], exe)
+    feed = (np.arange(dim, dtype=np.float32) / dim).reshape(1, dim)
+    (expected,) = exe.run(fluid.default_main_program(), feed={"x": feed},
+                          fetch_list=[pred])
+    return d, dim, np.asarray(expected).ravel()
+
+
+def test_c_program_matches_python_inference(capi_binary, saved_model):
+    model_dir, dim, expected = saved_model
+    env = dict(os.environ)
+    env["PADDLE_TPU_ROOT"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run([capi_binary, model_dir, str(dim)],
+                         capture_output=True, text=True, env=env, timeout=300)
+    assert out.returncode == 0, out.stderr
+    line = [l for l in out.stdout.splitlines() if l.startswith("output:")][0]
+    got = np.array([float(t) for t in line.split()[1:]], np.float32)
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+    assert abs(got.sum() - 1.0) < 1e-4  # softmax row
+
+
+def test_c_program_reports_bad_model_dir(capi_binary, tmp_path):
+    env = dict(os.environ)
+    env["PADDLE_TPU_ROOT"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run([capi_binary, str(tmp_path / "nope"), "8"],
+                         capture_output=True, text=True, env=env, timeout=300)
+    assert out.returncode == 1
+    assert "create failed" in out.stderr

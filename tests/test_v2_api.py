@@ -124,3 +124,35 @@ def test_reader_decorators():
                               paddle.dataset.uci_housing.train())
     row = next(c())
     assert len(row) == 4  # two (x, y) pairs concatenated
+
+
+def test_new_datasets_schemas():
+    """flowers/mq2007/voc2012 record contracts (reference:
+    python/paddle/v2/dataset/{flowers,mq2007,voc2012}.py)."""
+    from paddle_tpu.v2.dataset import flowers, mq2007, voc2012
+
+    x, y = next(flowers.train()())
+    assert x.shape == (3 * 32 * 32,) and x.dtype == np.float32
+    assert 0 <= y < flowers.CLASS_NUM
+
+    left, right = next(mq2007.train(format="pairwise")())
+    assert left.shape == (46,) and right.shape == (46,)
+    xf, rel = next(mq2007.train(format="pointwise")())
+    assert xf.shape == (46,) and rel in (0.0, 1.0, 2.0)
+    labels, feats = next(mq2007.train(format="listwise")())
+    assert len(labels) == len(feats)
+
+    img, mask = next(voc2012.train()())
+    assert img.shape[0] == 3 and img.shape[1:] == mask.shape
+    vals = set(np.unique(mask).tolist()) - {voc2012.IGNORE_LABEL}
+    assert vals <= set(range(voc2012.CLASS_NUM))
+    # image and mask agree: pixels of one class share a color
+    cls = next(iter(vals - {0}), None)
+    if cls is not None:
+        ys, xs = np.where(mask == cls)
+        colors = img[:, ys, xs]
+        assert colors.std(axis=1).max() < 0.2
+
+    # determinism across calls
+    x2, y2 = next(flowers.train()())
+    np.testing.assert_array_equal(x, x2)
