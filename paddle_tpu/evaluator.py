@@ -51,3 +51,105 @@ class Accuracy(Evaluator):
 
     def eval(self, executor=None):
         return self._c / max(self._t, 1)
+
+
+class DetectionMAP(Evaluator):
+    """Mean average precision over accumulated detections (reference:
+    gserver/evaluators/DetectionMAPEvaluator.cpp — 11point/integral AP).
+
+    Host-side accumulator: feed it the padded ``multiclass_nms`` output
+    (rows [label, score, x1, y1, x2, y2], label -1 = pad) and the padded
+    ground truth per batch via :meth:`update`.
+    """
+
+    def __init__(self, overlap_threshold: float = 0.5,
+                 ap_version: str = "integral", background_label: int = 0):
+        self.overlap_threshold = overlap_threshold
+        self.ap_version = ap_version
+        self.background_label = background_label
+        self.reset()
+
+    def reset(self, executor=None):
+        self._dets = []   # (img_id, label, score, box)
+        self._gts = []    # (img_id, label, box)
+        self._img = 0
+
+    @staticmethod
+    def _iou(a, b):
+        lt = np.maximum(a[:2], b[:2])
+        rb = np.minimum(a[2:], b[2:])
+        wh = np.clip(rb - lt, 0, None)
+        inter = wh[0] * wh[1]
+        ua = (max(a[2] - a[0], 0) * max(a[3] - a[1], 0)
+              + max(b[2] - b[0], 0) * max(b[3] - b[1], 0) - inter)
+        return inter / max(ua, 1e-10)
+
+    def update(self, nms_out, gt_boxes, gt_labels):
+        """nms_out (B, K, 6); gt_boxes (B, G, 4); gt_labels (B, G),
+        -1 padded."""
+        nms_out = np.asarray(nms_out)
+        gt_boxes = np.asarray(gt_boxes)
+        gt_labels = np.asarray(gt_labels)
+        for b in range(nms_out.shape[0]):
+            img = self._img
+            self._img += 1
+            for row in nms_out[b]:
+                if row[0] >= 0:
+                    self._dets.append((img, int(row[0]), float(row[1]),
+                                       row[2:6].copy()))
+            for g in range(gt_boxes.shape[1]):
+                if gt_labels[b, g] >= 0:
+                    self._gts.append((img, int(gt_labels[b, g]),
+                                      gt_boxes[b, g].copy()))
+
+    def eval(self, executor=None):
+        classes = sorted({g[1] for g in self._gts})
+        aps = []
+        for c in classes:
+            if c == self.background_label:
+                continue
+            gts = [(i, box) for i, lab, box in self._gts if lab == c]
+            dets = sorted((d for d in self._dets if d[1] == c),
+                          key=lambda d: -d[2])
+            npos = len(gts)
+            if npos == 0:
+                continue
+            used = set()
+            tp = np.zeros(len(dets))
+            fp = np.zeros(len(dets))
+            for k, (img, _, score, box) in enumerate(dets):
+                # VOC semantics (DetectionMAPEvaluator.cpp): match the
+                # argmax-IoU GT; if it's below threshold OR already
+                # claimed by a higher-scoring det, this det is a FP —
+                # it does NOT fall through to the next-best GT.
+                best_j, best_ov = -1, 0.0
+                for j, (gi, g) in enumerate(gts):
+                    if gi != img:
+                        continue
+                    ov = self._iou(box, g)
+                    if ov > best_ov:
+                        best_j, best_ov = j, ov
+                if (best_j >= 0 and best_ov >= self.overlap_threshold
+                        and best_j not in used):
+                    used.add(best_j)
+                    tp[k] = 1
+                else:
+                    fp[k] = 1
+            ctp = np.cumsum(tp)
+            cfp = np.cumsum(fp)
+            recall = ctp / npos
+            precision = ctp / np.maximum(ctp + cfp, 1e-10)
+            if self.ap_version == "11point":
+                ap = float(np.mean([
+                    max([p for r, p in zip(recall, precision) if r >= t],
+                        default=0.0)
+                    for t in np.linspace(0, 1, 11)]))
+            else:  # integral
+                ap = 0.0
+                prev_r = 0.0
+                for r, p in zip(recall, precision):
+                    ap += (r - prev_r) * p
+                    prev_r = r
+                ap = float(ap)
+            aps.append(ap)
+        return float(np.mean(aps)) if aps else 0.0

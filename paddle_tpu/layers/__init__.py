@@ -4,3 +4,4 @@ from paddle_tpu.layers.tensor import *  # noqa: F401,F403
 from paddle_tpu.layers.sequence import *  # noqa: F401,F403
 from paddle_tpu.layers.ops import *  # noqa: F401,F403
 from paddle_tpu.layers.control_flow import *  # noqa: F401,F403
+from paddle_tpu.layers.detection import *  # noqa: F401,F403

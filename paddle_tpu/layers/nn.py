@@ -20,6 +20,7 @@ __all__ = [
     "dropout",
     "cross_entropy",
     "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits",
     "square_error_cost",
     "accuracy",
     "topk",
@@ -34,6 +35,9 @@ __all__ = [
     "gru_unit",
     "linear_chain_crf",
     "crf_decoding",
+    "warpctc",
+    "hsigmoid",
+    "factorization_machine",
 ]
 
 
@@ -446,7 +450,20 @@ def matmul(x, y, transpose_x=False, transpose_y=False, **kwargs):
         if len(ys) >= 2 and transpose_y:
             ys[-1], ys[-2] = ys[-2], ys[-1]
         if len(xs) >= 2 and len(ys) >= 2:
-            batch = xs[:-2] if len(xs) >= len(ys) else ys[:-2]
+            # numpy-style broadcast of the batch dims (right-aligned);
+            # mismatched static dims fall back to -1 (dynamic)
+            xb, yb = xs[:-2], ys[:-2]
+            n = max(len(xb), len(yb))
+            xb = [1] * (n - len(xb)) + list(xb)
+            yb = [1] * (n - len(yb)) + list(yb)
+            batch = []
+            for a, b in zip(xb, yb):
+                if a == 1:
+                    batch.append(b)
+                elif b == 1 or a == b:
+                    batch.append(a)
+                else:
+                    batch.append(-1)
             shape = tuple(batch) + (xs[-2], ys[-1])
         elif len(xs) == 1 and len(ys) >= 2:
             shape = tuple(ys[:-2]) + (ys[-1],)
@@ -598,3 +615,70 @@ def crf_decoding(input, param_attr, label=None, length=None, **kwargs):
     helper.append_op(type="crf_decoding", inputs=inputs,
                      outputs={"ViterbiPath": [path]})
     return path
+
+
+def sigmoid_cross_entropy_with_logits(x, label, **kwargs):
+    """Per-element sigmoid BCE on logits (reference: fluid layers →
+    operators/sigmoid_cross_entropy_with_logits_op.cc)."""
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", **kwargs)
+    out = helper.create_tmp_variable(x.dtype, x.shape, x.lod_level)
+    helper.append_op(type="sigmoid_cross_entropy_with_logits",
+                     inputs={"X": [x], "Label": [label]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def warpctc(input, label, input_length=None, label_length=None, blank=0,
+            norm_by_times=False, **kwargs):
+    """CTC loss over padded (B, T, C) logits (reference capability:
+    gserver WarpCTCLayer / CTCLayer via hl_warpctc_wrap; op:
+    ops/ctc_ops.py lax.scan forward algorithm).  Returns (B, 1) loss."""
+    helper = LayerHelper("warpctc", **kwargs)
+    loss = helper.create_tmp_variable(input.dtype, (input.shape[0], 1))
+    inputs = {"Logits": [input], "Label": [label]}
+    if input_length is not None:
+        inputs["LogitsLength"] = [input_length]
+    if label_length is not None:
+        inputs["LabelLength"] = [label_length]
+    helper.append_op(type="warpctc", inputs=inputs,
+                     outputs={"Loss": [loss]},
+                     attrs={"blank": int(blank),
+                            "norm_by_times": bool(norm_by_times)})
+    return loss
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             **kwargs):
+    """Hierarchical sigmoid cost (reference:
+    gserver/layers/HierarchicalSigmoidLayer.cpp).  Returns (B, 1)."""
+    helper = LayerHelper("hsigmoid", param_attr=param_attr,
+                         bias_attr=bias_attr, **kwargs)
+    dtype = input.dtype
+    d = input.shape[-1]
+    w = helper.create_parameter(param_attr, shape=[num_classes - 1, d],
+                                dtype=dtype)
+    inputs = {"X": [input], "W": [w], "Label": [label]}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[num_classes - 1],
+                                    dtype=dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    cost = helper.create_tmp_variable(dtype, (input.shape[0], 1))
+    helper.append_op(type="hierarchical_sigmoid", inputs=inputs,
+                     outputs={"Cost": [cost]})
+    return cost
+
+
+def factorization_machine(input, factor_size, param_attr=None, **kwargs):
+    """Second-order FM interaction (reference:
+    gserver/layers/FactorizationMachineLayer.cpp).  (B, D) -> (B, 1);
+    combine with an fc for the linear term."""
+    helper = LayerHelper("factorization_machine", param_attr=param_attr,
+                         **kwargs)
+    d = input.shape[-1]
+    w = helper.create_parameter(param_attr, shape=[d, factor_size],
+                                dtype=input.dtype)
+    out = helper.create_tmp_variable(input.dtype, (input.shape[0], 1))
+    helper.append_op(type="factorization_machine",
+                     inputs={"X": [input], "W": [w]},
+                     outputs={"Out": [out]})
+    return out

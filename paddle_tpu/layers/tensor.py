@@ -29,6 +29,8 @@ __all__ = [
     "reduce_mean",
     "reduce_max",
     "reduce_min",
+    "gaussian_random",
+    "uniform_random",
 ]
 
 
@@ -219,3 +221,30 @@ reduce_sum = _reduce("reduce_sum")
 reduce_mean = _reduce("reduce_mean")
 reduce_max = _reduce("reduce_max")
 reduce_min = _reduce("reduce_min")
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, dtype="float32", seed=0,
+                    **kwargs):
+    """In-graph N(mean, std) sample (reference: fluid layers
+    gaussian_random → operators/gaussian_random_op.cc); seed=0 draws
+    from the executor's per-step RNG stream."""
+    helper = LayerHelper("gaussian_random", **kwargs)
+    out = helper.create_tmp_variable(dtype, tuple(shape))
+    helper.append_op(type="gaussian_random", outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "mean": float(mean),
+                            "std": float(std), "dtype": dtype,
+                            "seed": int(seed)})
+    return out
+
+
+def uniform_random(shape, min=-1.0, max=1.0, dtype="float32", seed=0,
+                   **kwargs):
+    """In-graph U(min, max) sample (reference: fluid layers
+    uniform_random → operators/uniform_random_op.cc)."""
+    helper = LayerHelper("uniform_random", **kwargs)
+    out = helper.create_tmp_variable(dtype, tuple(shape))
+    helper.append_op(type="uniform_random", outputs={"Out": [out]},
+                     attrs={"shape": list(shape), "min": float(min),
+                            "max": float(max), "dtype": dtype,
+                            "seed": int(seed)})
+    return out

@@ -21,3 +21,5 @@ from paddle_tpu.ops import beam_ops  # noqa: F401
 from paddle_tpu.ops import io_ops  # noqa: F401
 from paddle_tpu.ops import attention_ops  # noqa: F401
 from paddle_tpu.ops import pipeline_ops  # noqa: F401
+from paddle_tpu.ops import ctc_ops  # noqa: F401
+from paddle_tpu.ops import detection_ops  # noqa: F401

@@ -1,0 +1,155 @@
+"""CTC loss + hierarchical sigmoid + factorization machine ops.
+
+Reference: the v1 gserver capability set — `CTCLayer`/`WarpCTCLayer`
+(gserver/layers/CTCLayer.cpp, WarpCTCLayer.cpp over
+cuda/hl_warpctc_wrap.cc), `HierarchicalSigmoidLayer`
+(gserver/layers/HierarchicalSigmoidLayer.cpp), and
+`FactorizationMachineLayer` (gserver/layers/FactorizationMachineLayer.cpp).
+
+TPU-native designs:
+  - CTC: the log-space alpha recursion as one `lax.scan` over time with
+    static (B, 2S+1) state — no warp kernels; the gradient is plain
+    autodiff through the scan (exact, same as warpctc's analytic grad).
+  - HSigmoid: complete-binary-tree path codes are bit arithmetic on the
+    label id, so the whole loss is a handful of gathers + a masked
+    logistic sum — O(B * log V) dense compute, MXU-friendly.
+  - FM: the classic (sum_xw)^2 - sum(x^2 w^2) identity — two matmuls.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.ops.common import unwrap
+from paddle_tpu.registry import register_op
+
+NEG_INF = -1e30
+
+
+def _ctc_loss_batch(logits, logit_lens, labels, label_lens, blank):
+    """logits (B,T,C) raw; labels (B,S) int32; returns (B,) -logp."""
+    B, T, C = logits.shape
+    S = labels.shape[1]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    # extended label sequence l' = [blank, l1, blank, l2, ..., blank]
+    L = 2 * S + 1
+    ext = jnp.full((B, L), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels.astype(jnp.int32))
+    ext_len = 2 * label_lens.astype(jnp.int32) + 1
+
+    # can we skip from s-2 to s? only if ext[s] != blank and ext[s] != ext[s-2]
+    ext_prev2 = jnp.concatenate([jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], 1)
+    can_skip = (ext != blank) & (ext != ext_prev2)
+
+    def emit(t):
+        # log p(ext_s at time t) for every s: gather along class axis
+        return jnp.take_along_axis(logp[:, t, :], ext, axis=1)  # (B, L)
+
+    alpha0 = jnp.full((B, L), NEG_INF, jnp.float32)
+    alpha0 = alpha0.at[:, 0].set(logp[:, 0, blank])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(label_lens > 0,
+                  jnp.take_along_axis(logp[:, 0, :],
+                                      ext[:, 1:2], axis=1)[:, 0],
+                  NEG_INF))
+
+    def step(alpha, t):
+        prev1 = jnp.concatenate(
+            [jnp.full((B, 1), NEG_INF, jnp.float32), alpha[:, :-1]], 1)
+        prev2 = jnp.concatenate(
+            [jnp.full((B, 2), NEG_INF, jnp.float32), alpha[:, :-2]], 1)
+        prev2 = jnp.where(can_skip, prev2, NEG_INF)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+        new = merged + emit(t)
+        # freeze past each sequence's end so short sequences read their
+        # final alpha at t = len-1
+        active = (t < logit_lens)[:, None]
+        new = jnp.where(active, new, alpha)
+        return new, None
+
+    alphaT, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    # p(labels) = alpha[len'-1] + alpha[len'-2]; for an empty label
+    # (len'=1) there is only the all-blank path — no second term
+    last = jnp.take_along_axis(alphaT, (ext_len - 1)[:, None], axis=1)[:, 0]
+    last2 = jnp.take_along_axis(
+        alphaT, jnp.maximum(ext_len - 2, 0)[:, None], axis=1)[:, 0]
+    return -jnp.where(ext_len > 1, jnp.logaddexp(last, last2), last)
+
+
+@register_op("warpctc",
+             inputs=("Logits", "Label", "LogitsLength", "LabelLength"),
+             outputs=("Loss",), diff_inputs=("Logits",))
+def _warpctc(ctx):
+    """CTC negative log-likelihood over padded (B, T, C) logits
+    (reference: WarpCTCLayer semantics; `blank` attr as in hl_warpctc).
+    Differentiable by construction — jax.vjp through the scan gives the
+    exact warpctc gradient."""
+    logits = unwrap(ctx.input("Logits"))
+    labels = unwrap(ctx.input("Label"))
+    B, T, _ = logits.shape
+    if ctx.has_input("LogitsLength"):
+        logit_lens = unwrap(ctx.input("LogitsLength")).reshape(-1).astype(jnp.int32)
+    else:
+        logit_lens = jnp.full((B,), T, jnp.int32)
+    if ctx.has_input("LabelLength"):
+        label_lens = unwrap(ctx.input("LabelLength")).reshape(-1).astype(jnp.int32)
+    else:
+        label_lens = jnp.full((B,), labels.shape[1], jnp.int32)
+    blank = int(ctx.attr("blank", 0))
+    norm = bool(ctx.attr("norm_by_times", False))
+    loss = _ctc_loss_batch(logits, logit_lens, labels, label_lens, blank)
+    if norm:
+        loss = loss / jnp.maximum(logit_lens.astype(jnp.float32), 1.0)
+    ctx.set_output("Loss", loss[:, None])
+
+
+@register_op("hierarchical_sigmoid", inputs=("X", "W", "Bias", "Label"),
+             outputs=("Cost",), diff_inputs=("X", "W", "Bias"))
+def _hsigmoid(ctx):
+    """Complete-binary-tree hierarchical sigmoid (reference:
+    gserver/layers/HierarchicalSigmoidLayer.cpp: num_classes-1 inner
+    nodes, left branch = code bit 0).  Tree layout matches the
+    reference's implicit heap order: internal node k has children
+    2k+1 / 2k+2; class c sits at leaf (num_classes - 1 + c)."""
+    x = unwrap(ctx.input("X")).astype(jnp.float32)          # (B, D)
+    w = unwrap(ctx.input("W")).astype(jnp.float32)          # (V-1, D)
+    label = unwrap(ctx.input("Label")).reshape(-1)          # (B,)
+    num_classes = w.shape[0] + 1
+    depth = int(np.ceil(np.log2(max(num_classes, 2))))
+
+    # walk up from the leaf: node ids and branch directions, static depth
+    node = label.astype(jnp.int32) + (num_classes - 1)
+    scores = jnp.zeros(label.shape, jnp.float32)
+    logits_all = x @ w.T                                    # (B, V-1)
+    if ctx.has_input("Bias"):
+        logits_all = logits_all + unwrap(ctx.input("Bias")).reshape(-1)
+    for _ in range(depth):
+        parent = (node - 1) // 2
+        is_right = (node % 2) == 0          # child 2k+2 -> right
+        valid = node > 0
+        logit = jnp.take_along_axis(
+            logits_all, jnp.maximum(parent, 0)[:, None], axis=1)[:, 0]
+        # p(branch) = sigmoid(+/- logit); sum log-probs along the path
+        z = jnp.where(is_right, -logit, logit)
+        step_cost = jax.nn.softplus(-z)     # -log sigmoid(z)
+        scores = scores + jnp.where(valid, step_cost, 0.0)
+        node = jnp.maximum(parent, 0)
+    ctx.set_output("Cost", scores[:, None])
+
+
+@register_op("factorization_machine", inputs=("X", "W"),
+             outputs=("Out",), diff_inputs=("X", "W"))
+def _factorization_machine(ctx):
+    """Second-order FM interaction term (reference:
+    gserver/layers/FactorizationMachineLayer.cpp): out =
+    0.5 * sum_k[(x @ W)_k^2 - (x^2 @ W^2)_k]."""
+    x = unwrap(ctx.input("X")).astype(jnp.float32)   # (B, D)
+    w = unwrap(ctx.input("W")).astype(jnp.float32)   # (D, K)
+    s = x @ w                                        # (B, K)
+    s2 = (x * x) @ (w * w)                           # (B, K)
+    ctx.set_output("Out", 0.5 * jnp.sum(s * s - s2, axis=1, keepdims=True))

@@ -121,6 +121,46 @@ class Trainer:
     def test(self):
         return self._sgd.test(self._reader_from_sources(train=False))
 
+    # -- model export (the `paddle merge_model` surface) --------------------
+
+    def load_parameters(self, model_dir: str):
+        """Load a params.tar from a pass dir, a save_dir (latest pass),
+        or a direct tar path (reference: Trainer --init_model_path /
+        ParamUtil::loadParameters)."""
+        path = model_dir
+        if os.path.isdir(path):
+            passes = sorted(d for d in os.listdir(path)
+                            if d.startswith("pass-"))
+            if passes:
+                path = os.path.join(path, passes[-1])
+            path = os.path.join(path, "params.tar")
+        with open(path, "rb") as f:
+            self.parameters.load_tar(f)
+
+    def export_inference_model(self, out_dir: str):
+        """Export the prediction slice + params as a
+        save_inference_model dir — the merged-model artifact the C API
+        loads (reference: `paddle merge_model` → capi
+        paddle_gradient_machine_create_for_inference_with_parameters)."""
+        from paddle_tpu import executor as executor_mod
+        from paddle_tpu import io as fluid_io
+        from paddle_tpu.executor import Executor
+        from paddle_tpu.framework import TPUPlace
+        from paddle_tpu.v2.inference import Inference
+
+        cost = self.conf.cost
+        data_names = set(self.conf.data_layers)
+        pred = next((p for p in cost.parents if p.name not in data_names),
+                    cost)
+        inf = Inference(pred, self.parameters)
+        feed_names = [n for n, _ in inf.topology.feed_types]
+        exe = Executor(TPUPlace())
+        with executor_mod.scope_guard(self.parameters.scope):
+            fluid_io.save_inference_model(
+                out_dir, feed_names, inf.topology.output_vars, exe,
+                main_program=inf.topology.main_program)
+        return out_dir
+
 
 def train_from_config(config_path: str, num_passes: int = 1,
                       save_dir: Optional[str] = None,

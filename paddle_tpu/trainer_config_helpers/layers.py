@@ -37,6 +37,7 @@ __all__ = [
     "regression_cost", "mse_cost", "multi_binary_label_cross_entropy",
     "huber_regression_cost", "hinge_cost", "sum_cost", "cos_sim",
     "crf_layer", "crf_decoding_layer", "nce_layer", "maxid_layer",
+    "warp_ctc_layer", "ctc_layer", "hsigmoid_layer", "factorization_machine",
     "expand_layer", "repeat_layer", "power_layer", "scaling_layer",
     "slope_intercept_layer", "interpolation_layer", "trans_layer",
     "pad_layer", "outputs",
@@ -737,3 +738,71 @@ def nce_layer(input, label, num_classes: int, num_neg_samples: int = 10,
 
     lo = LayerOutput(name or _v2._uname("nce"), [input, label], build, size=1)
     return _record(lo, "nce")
+
+
+def warp_ctc_layer(input, label, size=None, blank=0, norm_by_times=False,
+                   name=None, **kwargs):
+    """CTC cost over a sequence of per-step class scores (reference:
+    gserver/layers/WarpCTCLayer.cpp; op ops/ctc_ops.py warpctc)."""
+
+    def build(ctx, lg, lab):
+        from paddle_tpu.layer_helper import LayerHelper
+        from paddle_tpu import layers as L
+
+        helper = LayerHelper("warp_ctc")
+        lg_var = lg.var if isinstance(lg, SeqVal) else lg
+        loss = helper.create_tmp_variable("float32", None)
+        ins = {"Logits": [lg_var],
+               "Label": [lab.var if isinstance(lab, SeqVal) else lab]}
+        if isinstance(lg, SeqVal):
+            ins["LogitsLength"] = [lg.lengths]
+        if isinstance(lab, SeqVal):
+            ins["LabelLength"] = [lab.lengths]
+        helper.append_op(type="warpctc", inputs=ins,
+                         outputs={"Loss": [loss]},
+                         attrs={"blank": int(blank),
+                                "norm_by_times": bool(norm_by_times)})
+        return L.mean(loss)
+
+    lo = LayerOutput(name or _v2._uname("warp_ctc"), [input, label], build,
+                     size=1)
+    return _record(lo, "warp_ctc")
+
+
+ctc_layer = warp_ctc_layer  # CTCLayer.cpp shares the contract
+
+
+def hsigmoid_layer(input, label, num_classes, param_attr=None,
+                   bias_attr=None, name=None, **kwargs):
+    """Hierarchical sigmoid cost (reference:
+    gserver/layers/HierarchicalSigmoidLayer.cpp)."""
+
+    def build(ctx, x, lab):
+        from paddle_tpu import layers as L
+
+        x_var = x.var if isinstance(x, SeqVal) else x
+        cost = L.hsigmoid(x_var,
+                          lab.var if isinstance(lab, SeqVal) else lab,
+                          num_classes, param_attr=param_attr,
+                          bias_attr=bias_attr)
+        return L.mean(cost)
+
+    lo = LayerOutput(name or _v2._uname("hsigmoid"), [input, label], build,
+                     size=1)
+    return _record(lo, "hsigmoid")
+
+
+def factorization_machine(input, factor_size, param_attr=None, name=None,
+                          **kwargs):
+    """Second-order FM interaction (reference:
+    gserver/layers/FactorizationMachineLayer.cpp)."""
+
+    def build(ctx, x):
+        from paddle_tpu import layers as L
+
+        return L.factorization_machine(
+            x.var if isinstance(x, SeqVal) else x, factor_size,
+            param_attr=param_attr)
+
+    lo = LayerOutput(name or _v2._uname("fm"), [input], build, size=1)
+    return _record(lo, "factorization_machine")

@@ -1,0 +1,104 @@
+#!/usr/bin/env python3
+"""Cluster launcher (reference: paddle/scripts/cluster_train/paddle.py —
+the SSH fan-out that started pservers + trainers across nodes;
+cluster_train_v2/ fabric + OpenMPI variants).
+
+Local/processes edition: starts the coordination store, the master
+task-dispatch service, N pserver shards, and M trainer processes, wiring
+addresses through environment variables:
+
+  PADDLE_COORD        coord store address
+  PADDLE_MASTER       master address
+  PADDLE_PSERVERS     comma-separated pserver addresses
+  PADDLE_TRAINER_ID   0..M-1
+  PADDLE_TRAINERS     M
+
+For multi-host runs, invoke this once per host with --ssh_prefix (any
+remote-exec wrapper) exactly like the reference's fabric launcher; the
+coordination store is the rendezvous.
+
+Usage:
+  python scripts/cluster_launch.py --pservers=2 --trainers=2 \
+      -- python my_trainer.py
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    opts = {"pservers": 2, "trainers": 1, "checkpoint_dir": ""}
+    while argv and argv[0].startswith("--"):
+        a = argv.pop(0)
+        if a == "--":
+            break
+        k, _, v = a[2:].partition("=")
+        opts[k] = v
+    trainer_cmd = argv
+    if not trainer_cmd:
+        print(__doc__, file=sys.stderr)
+        return 2
+
+    from paddle_tpu.distributed import (CoordClient, CoordServer,
+                                        MasterServer, ParameterServer)
+
+    n_ps = int(opts["pservers"])
+    n_tr = int(opts["trainers"])
+
+    coord = CoordServer()
+    master = MasterServer()
+    pservers = []
+    for i in range(n_ps):
+        ck = (os.path.join(opts["checkpoint_dir"], f"pserver-{i}.ckpt")
+              if opts["checkpoint_dir"] else "")
+        pservers.append(ParameterServer(checkpoint_path=ck,
+                                        checkpoint_sec=30 if ck else 0))
+    # publish through the coordination store (addr discovery contract:
+    # go/master/etcd_client.go + go/pserver/etcd_client.go)
+    cc = CoordClient(coord.address)
+    cc.put(cc.MASTER_KEY, master.address.encode())
+    for i, ps in enumerate(pservers):
+        cc.put(f"{cc.PSERVER_PREFIX}{i}", ps.address.encode())
+
+    env_base = dict(os.environ)
+    env_base.update({
+        "PADDLE_COORD": coord.address,
+        "PADDLE_MASTER": master.address,
+        "PADDLE_PSERVERS": ",".join(p.address for p in pservers),
+        "PADDLE_TRAINERS": str(n_tr),
+    })
+    procs = []
+    for tid in range(n_tr):
+        env = dict(env_base, PADDLE_TRAINER_ID=str(tid))
+        procs.append(subprocess.Popen(trainer_cmd, env=env))
+    print(f"launched {n_ps} pservers + master + coord; "
+          f"{n_tr} trainers running", flush=True)
+
+    rc = 0
+    try:
+        for p in procs:
+            rc = p.wait() or rc
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            p.wait()
+        rc = 130
+    finally:
+        cc.close()
+        for ps in pservers:
+            ps.stop()
+        master.stop()
+        coord.stop()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,102 @@
+"""CLI surface tests: the `paddle` wrapper (reference:
+paddle/scripts/submit_local.sh.in — train/version/merge_model) and the
+cluster launcher (reference: paddle/scripts/cluster_train/paddle.py)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PADDLE = os.path.join(REPO, "scripts", "paddle")
+ENV = dict(os.environ, JAX_PLATFORMS="cpu")
+
+
+def _run(*args, timeout=300):
+    return subprocess.run([sys.executable, *args], capture_output=True,
+                          text=True, env=ENV, timeout=timeout, cwd=REPO)
+
+
+def test_paddle_version():
+    out = _run(PADDLE, "version")
+    assert out.returncode == 0, out.stderr
+    assert "paddle_tpu" in out.stdout and "jax" in out.stdout
+
+
+def test_paddle_unknown_command():
+    out = _run(PADDLE, "frobnicate")
+    assert out.returncode == 2
+    assert "unknown command" in out.stderr
+
+
+def test_paddle_train_then_merge_model_then_c_inference(tmp_path):
+    """Full reference workflow: `paddle train` -> pass dirs ->
+    `paddle merge_model` -> inference artifact loadable by the Python
+    executor (capi loads the same artifact; covered in test_capi)."""
+    save_dir = str(tmp_path / "out")
+    out = _run(PADDLE, "train", "--config=demos/mnist_v1/trainer_config.py",
+               "--num_passes=3", f"--save_dir={save_dir}", timeout=560)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert os.path.exists(os.path.join(save_dir, "pass-00000", "params.tar"))
+
+    merged = str(tmp_path / "merged")
+    out = _run(PADDLE, "merge_model",
+               "--config=demos/mnist_v1/trainer_config.py",
+               f"--model_dir={save_dir}", f"--out={merged}", timeout=560)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert os.path.exists(os.path.join(merged, "__model__.json"))
+
+    # reload in-process and classify
+    import paddle_tpu as fluid
+
+    fluid.framework.reset_default_programs()
+    scope = fluid.executor.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.executor.scope_guard(scope):
+        prog, feeds, fetches = fluid.io.load_inference_model(merged, exe)
+        rng = np.random.RandomState(7)
+        protos = rng.randn(10, 784).astype("float32")
+        (probs,) = exe.run(prog, feed={feeds[0]: protos},
+                           fetch_list=fetches)
+    probs = np.asarray(probs)
+    assert probs.shape == (10, 10)
+    np.testing.assert_allclose(probs.sum(1), 1.0, atol=1e-4)
+    # trained on prototype classes: diagonal should dominate
+    assert (probs.argmax(1) == np.arange(10)).mean() > 0.8
+
+
+def test_cluster_launch_end_to_end(tmp_path):
+    """Launcher brings up coord+master+pservers and a remote trainer
+    converges (the fabric-launcher workflow, single host)."""
+    trainer_script = tmp_path / "trainer.py"
+    trainer_script.write_text("""
+import os, sys
+sys.path.insert(0, %r)
+import numpy as np
+import paddle_tpu.v2 as paddle
+
+paddle.init(use_gpu=False, trainer_count=1)
+x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(13))
+y = paddle.layer.data(name="y", type=paddle.data_type.dense_vector(1))
+pred = paddle.layer.fc(input=x, size=1)
+cost = paddle.layer.mse_cost(input=pred, label=y)
+params = paddle.parameters.create(cost)
+opt = paddle.optimizer.Momentum(momentum=0.9, learning_rate=1e-3)
+tr = paddle.trainer.SGD(cost=cost, parameters=params, update_equation=opt,
+                        is_local=False,
+                        pserver_addrs=os.environ["PADDLE_PSERVERS"].split(","))
+costs = []
+def h(e):
+    if isinstance(e, paddle.event.EndIteration):
+        costs.append(e.cost)
+reader = paddle.batch(paddle.dataset.uci_housing.train(), batch_size=32)
+tr.train(reader=reader, num_passes=2, event_handler=h)
+assert costs[-1] < 0.7 * costs[0], (costs[0], costs[-1])
+print("TRAINER_OK", costs[0], costs[-1])
+""" % REPO)
+    out = _run(os.path.join(REPO, "scripts", "cluster_launch.py"),
+               "--pservers=2", "--trainers=1", "--",
+               sys.executable, str(trainer_script), timeout=560)
+    assert out.returncode == 0, (out.stdout[-1000:], out.stderr[-2000:])
+    assert "launched 2 pservers" in out.stdout

@@ -1,0 +1,222 @@
+"""CTC / hierarchical-sigmoid / factorization-machine op tests.
+
+Oracles: torch.nn.functional.ctc_loss for CTC values+grads (the same
+role warp-ctc played for the reference's WarpCTCLayer tests,
+gserver/tests/test_WarpCTCLayer.cpp), numpy closed forms for hsigmoid
+and FM, and central-difference gradient checks in the OpTest style
+(fluid tests/op_test.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    fluid.framework.reset_default_programs()
+    yield
+
+
+def _run_ctc(logits, labels, logit_lens, label_lens, blank=0,
+             fetch_grad=False):
+    B, T, C = logits.shape
+    S = labels.shape[1]
+    lg = fluid.layers.data(name="lg", shape=[T, C], dtype="float32")
+    lb = fluid.layers.data(name="lb", shape=[S], dtype="int64")
+    ll = fluid.layers.data(name="ll", shape=[1], dtype="int64")
+    tl = fluid.layers.data(name="tl", shape=[1], dtype="int64")
+    # identity hop: data vars are stop-gradient, so probe the grad at
+    # the scale output instead
+    lg2 = fluid.layers.scale(lg, scale=1.0)
+    loss = fluid.layers.warpctc(lg2, lb, input_length=tl, label_length=ll,
+                                blank=blank)
+    avg = fluid.layers.mean(loss)
+    fetches = [loss]
+    if fetch_grad:
+        fluid.backward.append_backward(avg)
+        grad_name = lg2.name + "@GRAD"
+        fetches = [loss, fluid.default_main_program().global_block().var(grad_name)]
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    outs = exe.run(feed={"lg": logits, "lb": labels,
+                         "tl": logit_lens.reshape(-1, 1),
+                         "ll": label_lens.reshape(-1, 1)},
+                   fetch_list=fetches)
+    return [np.asarray(o) for o in outs]
+
+
+def _torch_ctc(logits, labels, logit_lens, label_lens, blank=0):
+    import torch
+    import torch.nn.functional as F
+
+    lg = torch.tensor(logits, requires_grad=True)
+    logp = F.log_softmax(lg, dim=-1).transpose(0, 1)  # (T, B, C)
+    loss = F.ctc_loss(logp, torch.tensor(labels),
+                      torch.tensor(logit_lens), torch.tensor(label_lens),
+                      blank=blank, reduction="none", zero_infinity=False)
+    loss.mean().backward()
+    return loss.detach().numpy(), lg.grad.numpy()
+
+
+def test_ctc_matches_torch_values_and_grads():
+    rng = np.random.RandomState(3)
+    B, T, C, S = 4, 12, 7, 5
+    logits = rng.randn(B, T, C).astype(np.float32)
+    label_lens = np.array([5, 3, 4, 1], np.int64)
+    logit_lens = np.array([12, 10, 12, 8], np.int64)
+    labels = np.zeros((B, S), np.int64)
+    for b in range(B):
+        labels[b, :label_lens[b]] = rng.randint(1, C, label_lens[b])
+
+    ours, ours_grad = _run_ctc(logits, labels, logit_lens, label_lens,
+                               fetch_grad=True)
+    ref, ref_grad = _torch_ctc(logits, labels, logit_lens, label_lens)
+    np.testing.assert_allclose(ours.ravel(), ref, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(ours_grad, ref_grad, rtol=1e-3, atol=1e-4)
+
+
+def test_ctc_repeated_labels():
+    """Repeats need the blank transition rule (the can_skip mask)."""
+    rng = np.random.RandomState(5)
+    B, T, C = 2, 10, 5
+    labels = np.array([[2, 2, 3, 0], [1, 1, 1, 1]], np.int64)
+    label_lens = np.array([3, 4], np.int64)
+    logit_lens = np.array([10, 10], np.int64)
+    logits = rng.randn(B, T, C).astype(np.float32)
+    ours, = _run_ctc(logits, labels, logit_lens, label_lens)
+    ref, _ = _torch_ctc(logits, labels, logit_lens, label_lens)
+    np.testing.assert_allclose(ours.ravel(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_trains_alignment_free():
+    """A tiny model learns to emit the right label with CTC supervision
+    (the WarpCTCLayer use case: per-sequence labels, no alignment)."""
+    rng = np.random.RandomState(0)
+    B, T, C, S = 8, 8, 4, 2
+    x = fluid.layers.data(name="x", shape=[T, C], dtype="float32")
+    lb = fluid.layers.data(name="lb", shape=[S], dtype="int64")
+    h = fluid.layers.fc(input=x, size=16, num_flatten_dims=2, act="tanh")
+    logits = fluid.layers.fc(input=h, size=C, num_flatten_dims=2)
+    loss = fluid.layers.mean(fluid.layers.warpctc(logits, lb))
+    fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    protos = rng.randn(3, T, C).astype(np.float32)  # class-pair prototypes
+    first = last = None
+    for _ in range(120):
+        ks = rng.randint(0, 3, B)
+        xs = protos[ks] + 0.2 * rng.randn(B, T, C).astype(np.float32)
+        ys = np.stack([(ks % 3) + 1, ((ks + 1) % 3) + 1], 1).astype(np.int64)
+        (l,) = exe.run(feed={"x": xs, "lb": ys}, fetch_list=[loss])
+        first = first if first is not None else float(l)
+        last = float(l)
+    assert last < 0.5 * first, (first, last)
+
+
+def _np_hsigmoid(x, w, b, label, num_classes):
+    B = x.shape[0]
+    out = np.zeros(B)
+    logits = x @ w.T + b
+    for i in range(B):
+        node = int(label[i]) + num_classes - 1
+        while node > 0:
+            parent = (node - 1) // 2
+            is_right = node % 2 == 0
+            z = logits[i, parent]
+            z = -z if is_right else z
+            out[i] += np.log1p(np.exp(-z))
+            node = parent
+    return out
+
+
+@pytest.mark.parametrize("num_classes", [8, 10, 17])
+def test_hsigmoid_matches_numpy(num_classes):
+    rng = np.random.RandomState(1)
+    B, D = 6, 5
+    xs = rng.randn(B, D).astype(np.float32)
+    lb = rng.randint(0, num_classes, (B, 1)).astype(np.int64)
+    x = fluid.layers.data(name="x", shape=[D], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    cost = fluid.layers.hsigmoid(x, label, num_classes)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = fluid.global_scope()
+    params = fluid.default_main_program().all_parameters()
+    wname = next(p.name for p in params if "w" in p.name.lower())
+    bname = next(p.name for p in params if p.name != wname)
+    w = rng.randn(num_classes - 1, D).astype(np.float32)
+    b = rng.randn(num_classes - 1).astype(np.float32)
+    scope.set(wname, w)
+    scope.set(bname, b)
+    (got,) = exe.run(feed={"x": xs, "label": lb}, fetch_list=[cost])
+    ref = _np_hsigmoid(xs, w, b, lb[:, 0], num_classes)
+    np.testing.assert_allclose(np.asarray(got).ravel(), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_hsigmoid_trains_as_classifier():
+    """Training the hsigmoid cost concentrates probability on the true
+    class path: cost on correct labels drops well below initial."""
+    rng = np.random.RandomState(2)
+    B, D, V = 32, 8, 16
+    x = fluid.layers.data(name="x", shape=[D], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(input=x, size=16, act="tanh")
+    cost = fluid.layers.mean(fluid.layers.hsigmoid(h, label, V))
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    protos = rng.randn(V, D).astype(np.float32)
+    first = last = None
+    for _ in range(100):
+        ys = rng.randint(0, V, B)
+        xs = protos[ys] + 0.1 * rng.randn(B, D).astype(np.float32)
+        (l,) = exe.run(feed={"x": xs, "label": ys.reshape(-1, 1).astype(np.int64)},
+                       fetch_list=[cost])
+        first = first if first is not None else float(l)
+        last = float(l)
+    assert last < 0.3 * first, (first, last)
+
+
+def test_factorization_machine_matches_numpy():
+    rng = np.random.RandomState(4)
+    B, D, K = 5, 7, 3
+    xs = rng.randn(B, D).astype(np.float32)
+    x = fluid.layers.data(name="x", shape=[D], dtype="float32")
+    out = fluid.layers.factorization_machine(x, factor_size=K)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = fluid.global_scope()
+    wname = fluid.default_main_program().all_parameters()[0].name
+    w = rng.randn(D, K).astype(np.float32)
+    scope.set(wname, w)
+    (got,) = exe.run(feed={"x": xs}, fetch_list=[out])
+    s = xs @ w
+    ref = 0.5 * np.sum(s * s - (xs ** 2) @ (w ** 2), axis=1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_factorization_machine_learns_interactions():
+    """FM recovers a pure pairwise-interaction target that a linear
+    model cannot fit."""
+    rng = np.random.RandomState(6)
+    B, D = 64, 6
+    x = fluid.layers.data(name="x", shape=[D], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    fm = fluid.layers.factorization_machine(x, factor_size=4)
+    lin = fluid.layers.fc(input=x, size=1)
+    pred = fluid.layers.elementwise_add(fm, lin)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    first = last = None
+    for _ in range(200):
+        xs = rng.randn(B, D).astype(np.float32)
+        ys = (xs[:, 0] * xs[:, 1] + 0.5 * xs[:, 2] * xs[:, 3]).astype(
+            np.float32).reshape(-1, 1)
+        (l,) = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+        first = first if first is not None else float(l)
+        last = float(l)
+    assert last < 0.15 * first, (first, last)

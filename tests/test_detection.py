@@ -1,0 +1,214 @@
+"""Detection stack tests (reference: gserver/tests/test_PriorBox.cpp,
+test_DetectionOutput.cpp, and the MultiBoxLossLayer grad entries of
+test_LayerGrad.cpp; plus a DetectionMAP evaluator check against a
+hand-computed AP)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    fluid.framework.reset_default_programs()
+    yield
+
+
+def test_prior_box_grid_geometry():
+    feat = fluid.layers.data(name="feat", shape=[8, 4, 4], dtype="float32")
+    img = fluid.layers.data(name="img", shape=[3, 64, 64], dtype="float32")
+    boxes, var = fluid.layers.prior_box(
+        feat, img, min_sizes=[16.0], max_sizes=[32.0], aspect_ratios=[2.0])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    b, v = exe.run(feed={"feat": np.zeros((1, 8, 4, 4), np.float32),
+                         "img": np.zeros((1, 3, 64, 64), np.float32)},
+                   fetch_list=[boxes, var])
+    b = np.asarray(b)
+    # P = 1 (min) + 2 (ar 2.0 + flip) + 1 (max) = 4
+    assert b.shape == (4, 4, 4, 4)
+    # first cell, square min-size prior: centered at (8, 8) px, 16x16
+    x1, y1, x2, y2 = b[0, 0, 0] * 64
+    assert abs((x1 + x2) / 2 - 8) < 1e-4 and abs((y1 + y2) / 2 - 8) < 1e-4
+    assert abs((x2 - x1) - 16) < 1e-3 and abs((y2 - y1) - 16) < 1e-3
+    assert np.all(b >= 0) and np.all(b <= 1)  # clipped
+    np.testing.assert_allclose(np.asarray(v)[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.RandomState(0)
+    M = 6
+    prior = np.sort(rng.rand(M, 4).astype(np.float32), axis=1)
+    pvar = np.full((M, 4), 0.1, np.float32)
+    target = np.sort(rng.rand(M, 4).astype(np.float32), axis=1)
+
+    pb = fluid.layers.data(name="pb", shape=[M, 4], dtype="float32")
+    pv = fluid.layers.data(name="pv", shape=[M, 4], dtype="float32")
+    tb = fluid.layers.data(name="tb", shape=[M, 4], dtype="float32")
+    enc = fluid.layers.box_coder(pb, pv, tb, code_type="encode_center_size")
+    dec = fluid.layers.box_coder(pb, pv, enc, code_type="decode_center_size")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    (out,) = exe.run(feed={"pb": prior[None], "pv": pvar[None],
+                           "tb": target[None]}, fetch_list=[dec])
+    np.testing.assert_allclose(np.asarray(out)[0], target, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_multiclass_nms_suppresses_overlaps():
+    M, C = 8, 3
+    boxes = np.zeros((M, 4), np.float32)
+    boxes[0] = [0.0, 0.0, 0.4, 0.4]
+    boxes[1] = [0.02, 0.02, 0.42, 0.42]   # overlaps box 0
+    boxes[2] = [0.6, 0.6, 0.9, 0.9]       # separate
+    boxes[3:] = [0.0, 0.0, 0.01, 0.01]    # junk
+    scores = np.zeros((1, C, M), np.float32)
+    scores[0, 1, 0] = 0.9
+    scores[0, 1, 1] = 0.8   # should be suppressed by 0
+    scores[0, 1, 2] = 0.7
+    scores[0, 2, 2] = 0.6   # other class, same box: kept separately
+
+    bb = fluid.layers.data(name="bb", shape=[M, 4], dtype="float32")
+    sc = fluid.layers.data(name="sc", shape=[C, M], dtype="float32")
+    out = fluid.layers.multiclass_nms(bb, sc, score_threshold=0.5,
+                                      nms_threshold=0.5, keep_top_k=8)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    (res,) = exe.run(feed={"bb": boxes[None], "sc": scores},
+                     fetch_list=[out])
+    res = np.asarray(res)[0]
+    kept = res[res[:, 0] >= 0]
+    # detections: (cls1, box0), (cls1, box2), (cls2, box2) — box1 gone
+    assert kept.shape[0] == 3
+    assert sorted(kept[:, 0].tolist()) == [1.0, 1.0, 2.0]
+    assert abs(kept[0, 1] - 0.9) < 1e-5  # sorted by score
+    assert not any(abs(r[1] - 0.8) < 1e-5 for r in kept)
+
+
+def test_ssd_loss_trains_localization_and_class():
+    """A trainable head fed fixed features learns to localize + classify
+    a synthetic single-object scene: loss decreases strongly."""
+    rng = np.random.RandomState(1)
+    B, M, C = 4, 16, 3
+    # priors: a 4x4 grid of 0.25-sized cells
+    gx, gy = np.meshgrid(np.arange(4), np.arange(4), indexing="ij")
+    prior = np.stack([gx / 4, gy / 4, (gx + 1) / 4, (gy + 1) / 4],
+                     axis=-1).reshape(M, 4).astype(np.float32)
+    pvar = np.full((M, 4), 0.1, np.float32)
+
+    feat = fluid.layers.data(name="feat", shape=[8], dtype="float32")
+    pb = fluid.layers.data(name="pb", shape=[M, 4], dtype="float32")
+    pv = fluid.layers.data(name="pv", shape=[M, 4], dtype="float32")
+    gtb = fluid.layers.data(name="gtb", shape=[1, 4], dtype="float32")
+    gtl = fluid.layers.data(name="gtl", shape=[1], dtype="int64")
+    h = fluid.layers.fc(input=feat, size=64, act="relu")
+    loc = fluid.layers.reshape(fluid.layers.fc(input=h, size=M * 4),
+                               [-1, M, 4])
+    conf = fluid.layers.reshape(fluid.layers.fc(input=h, size=M * C),
+                                [-1, M, C])
+    loss = fluid.layers.mean(fluid.layers.ssd_loss(loc, conf, pb, pv, gtb, gtl))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    cells = np.array([[0.0, 0.0, 0.25, 0.25], [0.75, 0.75, 1.0, 1.0]],
+                     np.float32)
+    first = last = None
+    for _ in range(150):
+        which = rng.randint(0, 2, B)
+        feats = np.stack([np.concatenate([np.ones(4) * w, np.zeros(4)])
+                          for w in which]).astype(np.float32)
+        feats += 0.05 * rng.randn(B, 8).astype(np.float32)
+        gt = cells[which][:, None, :]
+        lab = (which + 1).astype(np.int64).reshape(B, 1)
+        (l,) = exe.run(feed={"feat": feats,
+                             "pb": np.broadcast_to(prior, (B, M, 4)),
+                             "pv": np.broadcast_to(pvar, (B, M, 4)),
+                             "gtb": gt, "gtl": lab},
+                       fetch_list=[loss])
+        first = first if first is not None else float(l)
+        last = float(l)
+    assert last < 0.3 * first, (first, last)
+
+
+def test_detection_map_evaluator():
+    from paddle_tpu.evaluator import DetectionMAP
+
+    m = DetectionMAP(overlap_threshold=0.5, ap_version="integral")
+    # image 0: one gt of class 1 at [0,0,.5,.5]; det matches with score .9
+    # plus one false positive at score .8
+    nms_out = np.array([[[1, 0.9, 0.0, 0.0, 0.5, 0.5],
+                         [1, 0.8, 0.6, 0.6, 0.9, 0.9],
+                         [-1, 0, 0, 0, 0, 0]]], np.float32)
+    gt_boxes = np.array([[[0.0, 0.0, 0.5, 0.5]]], np.float32)
+    gt_labels = np.array([[1]], np.int64)
+    m.update(nms_out, gt_boxes, gt_labels)
+    # precision@1 = 1 at recall 1.0; the FP after doesn't reduce AP
+    assert abs(m.eval() - 1.0) < 1e-6
+    m.reset()
+    # now the high-scoring det is the FP: AP = 0.5 (tp at rank 2)
+    nms_out2 = np.array([[[1, 0.9, 0.6, 0.6, 0.9, 0.9],
+                          [1, 0.8, 0.0, 0.0, 0.5, 0.5],
+                          [-1, 0, 0, 0, 0, 0]]], np.float32)
+    m.update(nms_out2, gt_boxes, gt_labels)
+    assert abs(m.eval() - 0.5) < 1e-6
+
+
+def test_prior_box_count_with_unit_aspect_ratio():
+    """Declared shape must match emitted priors when aspect_ratios
+    contains 1.0 (deduped by the op)."""
+    feat = fluid.layers.data(name="feat", shape=[8, 2, 2], dtype="float32")
+    img = fluid.layers.data(name="img", shape=[3, 32, 32], dtype="float32")
+    boxes, _ = fluid.layers.prior_box(
+        feat, img, min_sizes=[8.0], aspect_ratios=[1.0, 2.0])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    (b,) = exe.run(feed={"feat": np.zeros((1, 8, 2, 2), np.float32),
+                         "img": np.zeros((1, 3, 32, 32), np.float32)},
+                   fetch_list=[boxes])
+    assert np.asarray(b).shape == tuple(boxes.shape), (
+        np.asarray(b).shape, boxes.shape)
+
+
+def test_detection_map_no_double_match():
+    """A second det whose argmax GT is claimed is a FP even if another
+    unused GT overlaps it above threshold (VOC matching rule)."""
+    from paddle_tpu.evaluator import DetectionMAP
+
+    m = DetectionMAP(overlap_threshold=0.5, ap_version="integral")
+    # GT-A [0,0,1,1]; GT-B [0,0,.6,1]: det1 and det2 both argmax to A
+    gt_boxes = np.array([[[0, 0, 1, 1], [0.0, 0.0, 0.6, 1.0]]], np.float32)
+    gt_labels = np.array([[1, 1]], np.int64)
+    nms_out = np.array([[[1, 0.9, 0.0, 0.0, 1.0, 1.0],     # TP on A
+                         [1, 0.8, 0.0, 0.0, 0.95, 1.0],    # argmax A -> FP
+                         [-1, 0, 0, 0, 0, 0]]], np.float32)
+    m.update(nms_out, gt_boxes, gt_labels)
+    # rank1 TP (p=1, r=.5), rank2 FP: integral AP = 0.5
+    assert abs(m.eval() - 0.5) < 1e-6
+
+
+def test_ctc_empty_label():
+    """label_length=0 rows: loss is the all-blank path NLL exactly."""
+    import torch
+    import torch.nn.functional as F
+
+    rng = np.random.RandomState(9)
+    B, T, C, S = 2, 6, 4, 3
+    logits = rng.randn(B, T, C).astype(np.float32)
+    labels = np.zeros((B, S), np.int64)
+    labels[0, :2] = [1, 2]
+    label_lens = np.array([2, 0], np.int64)
+    logit_lens = np.array([6, 6], np.int64)
+    import sys
+    sys.path.insert(0, "tests")
+    from test_ctc_hsig_fm import _run_ctc
+
+    fluid.framework.reset_default_programs()
+    ours, = _run_ctc(logits, labels, logit_lens, label_lens)
+    lg = torch.tensor(logits)
+    logp = F.log_softmax(lg, dim=-1).transpose(0, 1)
+    ref = F.ctc_loss(logp, torch.tensor(labels), torch.tensor(logit_lens),
+                     torch.tensor(label_lens), blank=0, reduction="none")
+    np.testing.assert_allclose(np.asarray(ours).ravel(), ref.numpy(),
+                               rtol=1e-4, atol=1e-4)
