@@ -1,0 +1,102 @@
+"""Model benchmark runner (reference: benchmark/paddle/image/*.py —
+AlexNet/GoogLeNet/VGG/ResNet/smallnet configs timed by run.sh — and
+benchmark/paddle/rnn/rnn.py for the LSTM text model; published numbers
+in benchmark/README.md + IntelOptimizedPaddle.md, mirrored in
+BASELINE.md).
+
+Usage:
+  python benchmark/run.py                    # all models, default sizes
+  python benchmark/run.py resnet50 alexnet   # a subset
+  BENCH_STEPS=20 BENCH_BATCH=64 python benchmark/run.py smallnet
+
+Prints one table row + one JSON line per model:
+  {"model": ..., "batch": ..., "img_per_sec": ..., "ms_per_batch": ...}
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _train_step_fn(model_name, batch):
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+
+    fluid.framework.reset_default_programs()
+    if model_name == "lstm":
+        T, emb, hid = 100, 512, 512
+        ids = fluid.layers.data(name="ids", shape=[T, 1], dtype="int64")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        pred = models.lstm_text_classifier(ids, class_dim=2, emb_dim=emb,
+                                           hidden=hid)
+        feed = lambda rng: {  # noqa: E731
+            "ids": rng.randint(0, 10000, (batch, T, 1)).astype(np.int64),
+            "label": rng.randint(0, 2, (batch, 1)).astype(np.int64)}
+    else:
+        image = {"smallnet": (3, 32, 32)}.get(model_name, (3, 224, 224))
+        classes = {"smallnet": 10}.get(model_name, 1000)
+        img = fluid.layers.data(name="img", shape=list(image),
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        net = {
+            "alexnet": models.alexnet,
+            "googlenet": models.googlenet,
+            "vgg16": models.vgg16,
+            "resnet50": models.resnet_imagenet,
+            "smallnet": lambda x, class_dim: models.resnet_cifar10(
+                x, depth=20, class_dim=class_dim),
+        }[model_name]
+        pred = net(img, class_dim=classes)
+        feed = lambda rng: {  # noqa: E731
+            "img": rng.rand(batch, *image).astype(np.float32),
+            "label": rng.randint(0, classes, (batch, 1)).astype(np.int64)}
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(input=pred,
+                                                        label=label))
+    fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9).minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe, fluid.default_main_program(), loss, feed
+
+
+DEFAULT_BATCH = {"alexnet": 128, "googlenet": 128, "vgg16": 64,
+                 "resnet50": 64, "smallnet": 256, "lstm": 64}
+
+
+def bench_model(model_name, batch=None, steps=None, warmup=2):
+    batch = batch or int(os.environ.get("BENCH_BATCH", 0)) \
+        or DEFAULT_BATCH[model_name]
+    steps = steps or int(os.environ.get("BENCH_STEPS", 10))
+    rng = np.random.RandomState(0)
+    exe, prog, loss, feed = _train_step_fn(model_name, batch)
+    for _ in range(warmup):
+        exe.run(prog, feed=feed(rng), fetch_list=[loss])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        (l,) = exe.run(prog, feed=feed(rng), fetch_list=[loss])
+    _ = float(np.asarray(l).ravel()[0])  # sync
+    dt = (time.perf_counter() - t0) / steps
+    return {"model": model_name, "batch": batch,
+            "img_per_sec": round(batch / dt, 2),
+            "ms_per_batch": round(dt * 1e3, 2)}
+
+
+def main(argv=None):
+    names = (argv or sys.argv[1:]) or list(DEFAULT_BATCH)
+    rows = []
+    for n in names:
+        r = bench_model(n)
+        rows.append(r)
+        print(f"{r['model']:<10} bs={r['batch']:<4} "
+              f"{r['img_per_sec']:>10.2f} img/s  "
+              f"{r['ms_per_batch']:>8.2f} ms/batch", flush=True)
+        print(json.dumps(r), flush=True)
+    return rows
+
+
+if __name__ == "__main__":
+    main()

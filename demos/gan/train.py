@@ -52,10 +52,15 @@ def build(batch=64, zdim=8):
                 if p.name.startswith("d_")]
     g_params = [p.name for p in fluid.default_main_program().all_parameters()
                 if p.name.startswith("g_")]
-    fluid.optimizer.Adam(learning_rate=2e-3).minimize(
-        d_loss, parameter_list=d_params)
-    fluid.optimizer.Adam(learning_rate=1e-3).minimize(
-        g_loss, parameter_list=g_params)
+    # BOTH backward passes are appended before EITHER update so the G
+    # gradient flows through the same D weights the forward pass used
+    # (minimize() would interleave D's update before G's backward)
+    d_pg = fluid.backward.append_backward(d_loss, parameter_list=d_params)
+    g_pg = fluid.backward.append_backward(g_loss, parameter_list=g_params)
+    opt_d = fluid.optimizer.Adam(learning_rate=2e-3)
+    opt_g = fluid.optimizer.Adam(learning_rate=1e-3)
+    opt_d._create_optimization_pass(d_pg, d_loss)
+    opt_g._create_optimization_pass(g_pg, g_loss)
     return z.name, real.name, fake, d_loss, g_loss
 
 

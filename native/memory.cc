@@ -1,0 +1,171 @@
+// Host staging memory: buddy allocator behind the Alloc/Free/Used
+// contract.
+//
+// C++ rebuild of the reference's memory layer (reference:
+// memory/memory.h:36-55 Alloc/Free/Used; memory/detail/
+// buddy_allocator.{h:33,cc} — power-of-two split/merge over chunked
+// system allocations; memory/detail/system_allocator.h:36-44; design
+// memory/README.md).  On TPU the device side (HBM) is owned by
+// PJRT/XLA — there is nothing to hand-allocate there — so the buddy
+// allocator's remaining job is what the reference used pinned host
+// memory for: staging buffers for the feed path (recordio → decode →
+// device transfer) with O(log n) alloc/free and coalescing, without
+// per-batch malloc/munmap churn.
+//
+// Semantics mirrored from the reference:
+//   - allocations are served from power-of-two "buddy" blocks carved
+//     out of large chunks obtained from the system allocator
+//   - a freed block merges with its buddy when both are free
+//   - requests above max_chunk_size bypass the pool and go straight to
+//     the system allocator (buddy_allocator.cc fallback path)
+//   - Used() reports bytes currently handed out (memory.h:52)
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <new>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t kMinBlock = 1 << 6;    // 64 B granularity
+
+struct Pool {
+  uint64_t chunk_size;
+  uint64_t max_pool_bytes;
+  std::mutex mu;
+  // free lists per power-of-two size: size -> set of offsets (addr)
+  std::map<uint64_t, std::map<uintptr_t, char*>> free_lists;
+  // live allocations: ptr -> block size
+  std::unordered_map<void*, uint64_t> live;
+  // oversize allocations served directly by the system allocator
+  std::unordered_map<void*, uint64_t> direct;
+  std::vector<char*> chunks;
+  uint64_t used_bytes = 0;
+  uint64_t pool_bytes = 0;
+
+  ~Pool() {
+    for (char* c : chunks) std::free(c);
+    for (auto& kv : direct) std::free(kv.first);
+  }
+
+  static uint64_t RoundUp(uint64_t n) {
+    uint64_t s = kMinBlock;
+    while (s < n) s <<= 1;
+    return s;
+  }
+
+  bool Grow() {
+    if (max_pool_bytes && pool_bytes + chunk_size > max_pool_bytes)
+      return false;
+    char* c = static_cast<char*>(std::aligned_alloc(4096, chunk_size));
+    if (!c) return false;
+    chunks.push_back(c);
+    pool_bytes += chunk_size;
+    free_lists[chunk_size].emplace(reinterpret_cast<uintptr_t>(c), c);
+    return true;
+  }
+
+  void* Alloc(uint64_t n) {
+    if (n == 0) n = 1;
+    std::lock_guard<std::mutex> l(mu);
+    if (n > chunk_size) {  // oversize: system allocator fallback
+      void* p = std::aligned_alloc(4096, RoundUp(n));
+      if (!p) return nullptr;
+      direct[p] = n;
+      used_bytes += n;
+      return p;
+    }
+    uint64_t want = RoundUp(n);
+    // find the smallest free block >= want
+    auto it = free_lists.lower_bound(want);
+    while (it != free_lists.end() && it->second.empty()) ++it;
+    if (it == free_lists.end()) {
+      if (!Grow()) return nullptr;
+      it = free_lists.find(chunk_size);
+    }
+    uint64_t size = it->first;
+    auto slot = it->second.begin();
+    char* p = slot->second;
+    it->second.erase(slot);
+    // split down to the target size, stashing the upper buddies
+    while (size > want) {
+      size >>= 1;
+      free_lists[size].emplace(reinterpret_cast<uintptr_t>(p + size),
+                               p + size);
+    }
+    live[p] = size;
+    used_bytes += size;
+    return p;
+  }
+
+  void Free(void* vp) {
+    if (!vp) return;
+    std::lock_guard<std::mutex> l(mu);
+    auto dit = direct.find(vp);
+    if (dit != direct.end()) {
+      used_bytes -= dit->second;
+      std::free(vp);
+      direct.erase(dit);
+      return;
+    }
+    auto lit = live.find(vp);
+    if (lit == live.end()) return;  // double free: ignore, like glog fatal-less build
+    char* p = static_cast<char*>(vp);
+    uint64_t size = lit->second;
+    used_bytes -= size;
+    live.erase(lit);
+    // merge with buddies while possible
+    while (size < chunk_size) {
+      // buddy address depends on this block's offset within its chunk;
+      // chunks are aligned, so offset parity decides the buddy side
+      char* chunk = nullptr;
+      for (char* c : chunks) {
+        if (p >= c && p < c + chunk_size) { chunk = c; break; }
+      }
+      if (!chunk) break;
+      uint64_t off = static_cast<uint64_t>(p - chunk);
+      char* buddy = (off & size) ? p - size : p + size;
+      auto& fl = free_lists[size];
+      auto bit = fl.find(reinterpret_cast<uintptr_t>(buddy));
+      if (bit == fl.end()) break;
+      fl.erase(bit);
+      if (buddy < p) p = buddy;
+      size <<= 1;
+    }
+    free_lists[size].emplace(reinterpret_cast<uintptr_t>(p), p);
+  }
+
+  uint64_t Used() {
+    std::lock_guard<std::mutex> l(mu);
+    return used_bytes;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+Pool* mem_pool_create(uint64_t chunk_size, uint64_t max_pool_bytes) {
+  auto* p = new Pool();
+  p->chunk_size = chunk_size ? Pool::RoundUp(chunk_size) : (64u << 20);
+  p->max_pool_bytes = max_pool_bytes;
+  return p;
+}
+
+void mem_pool_destroy(Pool* p) { delete p; }
+
+void* mem_alloc(Pool* p, uint64_t n) { return p ? p->Alloc(n) : nullptr; }
+
+void mem_free(Pool* p, void* ptr) {
+  if (p) p->Free(ptr);
+}
+
+uint64_t mem_used(Pool* p) { return p ? p->Used() : 0; }
+
+uint64_t mem_pool_bytes(Pool* p) { return p ? p->pool_bytes : 0; }
+
+}  // extern "C"

@@ -1,0 +1,179 @@
+"""SWIG-api compatibility surface.
+
+Reference: paddle/api/PaddleAPI.h:103-546 — the `swig_paddle` module the
+v2 Python API was built on: `initPaddle`, `Matrix`/`Vector`,
+`Arguments`, `GradientMachine` (createFromConfigProto / forward /
+forwardBackward / getParameters), `ParameterUpdater`, and
+`SequenceGenerator`.  The v2 facade here runs natively on the fluid
+core, so these classes are thin adapters kept for programs written
+against the SWIG layer; numpy replaces the Matrix/Vector buffer types
+exactly as py_paddle's converters did
+(paddle/py_paddle/dataprovider_converter.py).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+def initPaddle(*args):
+    """swig_paddle.initPaddle('--use_gpu=false', ...) — flag strings are
+    accepted and recorded; device selection is XLA's."""
+    from paddle_tpu import flags as _flags
+
+    for a in args:
+        if a.startswith("--") and "=" in a:
+            k, v = a[2:].split("=", 1)
+            _flags.FLAGS.set(k, v)
+
+
+class Arguments:
+    """Positional in/out slots (reference: api/PaddleAPI.h Arguments +
+    paddle/parameter/Argument.h).  Values are numpy arrays; sequence
+    slots carry (value, lengths)."""
+
+    def __init__(self, n: int):
+        self._vals: List[Optional[np.ndarray]] = [None] * n
+        self._lens: List[Optional[np.ndarray]] = [None] * n
+
+    @staticmethod
+    def createArguments(n: int) -> "Arguments":
+        return Arguments(n)
+
+    def getSlotNum(self) -> int:
+        return len(self._vals)
+
+    def resize(self, n: int):
+        self._vals = (self._vals + [None] * n)[:n]
+        self._lens = (self._lens + [None] * n)[:n]
+
+    def setSlotValue(self, i: int, value):
+        self._vals[i] = np.asarray(value)
+
+    def getSlotValue(self, i: int):
+        return self._vals[i]
+
+    def setSlotIds(self, i: int, ids):
+        self._vals[i] = np.asarray(ids, np.int64)
+
+    def getSlotIds(self, i: int):
+        return self._vals[i]
+
+    def setSlotSequenceStartPositions(self, i: int, lens):
+        self._lens[i] = np.asarray(lens, np.int32)
+
+    def getSlotSequenceStartPositions(self, i: int):
+        return self._lens[i]
+
+
+class GradientMachine:
+    """Forward/backward engine over a v2 Topology (reference:
+    api/GradientMachine.cpp over gserver GradientMachine::create)."""
+
+    def __init__(self, cost_or_outputs, parameters=None, is_test=False):
+        from paddle_tpu.v2 import parameters as v2p
+        from paddle_tpu.v2.topology import Topology
+        from paddle_tpu.v2.layer import LayerOutput
+
+        outs = (cost_or_outputs if isinstance(cost_or_outputs, (list, tuple))
+                else [cost_or_outputs])
+        self._output_layers = list(outs)
+        if is_test:
+            self.topology = Topology(cost=None, output_layers=self._output_layers,
+                                     is_test=True)
+            self.parameters = parameters
+        else:
+            self.topology = Topology(outs[0])
+            self.parameters = parameters or v2p.Parameters(self.topology)
+        from paddle_tpu.executor import Executor
+        from paddle_tpu.framework import TPUPlace
+        from paddle_tpu import backward as backward_mod
+        from paddle_tpu import framework
+
+        self._exe = Executor(TPUPlace())
+        self._grad_names = None
+        if not is_test:
+            with framework.program_guard(self.topology.main_program,
+                                         self.topology.startup_program):
+                pgs = backward_mod.append_backward(self.topology.cost_var)
+            self._grad_names = [(p.name, g.name) for p, g in pgs]
+        self._init()
+
+    @staticmethod
+    def createFromConfigProto(conf, *args, **kwargs) -> "GradientMachine":
+        """Accepts a parsed v1 TrainerConfig (trainer.config_parser) or
+        a cost LayerOutput."""
+        cost = getattr(conf, "cost", conf)
+        return GradientMachine(cost)
+
+    def _init(self):
+        from paddle_tpu import executor as executor_mod
+
+        if self.parameters is not None:
+            with executor_mod.scope_guard(self.parameters.scope):
+                self._exe.run(self.topology.startup_program)
+
+    def _feed_from_args(self, in_args: Arguments):
+        feed = {}
+        for i, (name, t) in enumerate(self.topology.feed_types):
+            v = in_args.getSlotValue(i)
+            if v is None:
+                raise ValueError(f"slot {i} ({name}) not set")
+            feed[name] = v
+            lens = in_args.getSlotSequenceStartPositions(i)
+            if lens is not None:
+                feed[name + "@len"] = lens
+        return feed
+
+    def forward(self, in_args: Arguments, out_args: Arguments, pass_type=None):
+        from paddle_tpu import executor as executor_mod
+
+        prog = self.topology.main_program.clone(for_test=True)
+        fetch = self.topology.output_vars
+        with executor_mod.scope_guard(self.parameters.scope):
+            outs = self._exe.run(prog, feed=self._feed_from_args(in_args),
+                                 fetch_list=fetch)
+        out_args.resize(len(outs))
+        for i, o in enumerate(outs):
+            out_args.setSlotValue(i, np.asarray(o))
+        return outs
+
+    def forwardBackward(self, in_args: Arguments, out_args: Arguments,
+                        pass_type=None):
+        """One fwd+bwd; gradients land in scope (param@GRAD) for the
+        updater, like the UpdateCallback contract."""
+        from paddle_tpu import executor as executor_mod
+
+        assert self._grad_names is not None, "test-mode machine"
+        fetch = [self.topology.cost_var] + [g for _, g in self._grad_names]
+        with executor_mod.scope_guard(self.parameters.scope):
+            outs = self._exe.run(self.topology.main_program,
+                                 feed=self._feed_from_args(in_args),
+                                 fetch_list=fetch)
+        out_args.resize(1)
+        out_args.setSlotValue(0, np.asarray(outs[0]))
+        self._last_grads = {p: np.asarray(g)
+                            for (p, _), g in zip(self._grad_names, outs[1:])}
+        return outs[0]
+
+    def getParameters(self):
+        return self.parameters
+
+    def getLayerOutputs(self, names):
+        raise NotImplementedError(
+            "fetch intermediate layers by adding them to output_layers")
+
+
+class SequenceGenerator:
+    """Reference api/PaddleAPI.h:546 — generation driver; adapter over
+    paddle_tpu.generation.SequenceGenerator."""
+
+    def __init__(self, beam_gen, parameters):
+        from paddle_tpu.generation import SequenceGenerator as _Gen
+
+        self._gen = _Gen(beam_gen, parameters)
+
+    def generate(self, row):
+        return self._gen.generate(row)

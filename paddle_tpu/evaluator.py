@@ -153,3 +153,45 @@ class DetectionMAP(Evaluator):
                 ap = float(ap)
             aps.append(ap)
         return float(np.mean(aps)) if aps else 0.0
+
+
+def edit_distance(a, b) -> int:
+    """Levenshtein distance (host-side helper for CTC error rates)."""
+    a, b = list(a), list(b)
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i]
+        for j, cb in enumerate(b, 1):
+            cur.append(min(prev[j] + 1, cur[-1] + 1,
+                           prev[j - 1] + (ca != cb)))
+        prev = cur
+    return prev[-1]
+
+
+class CTCError(Evaluator):
+    """Sequence error rate = total edit distance / total label length
+    (reference: gserver/evaluators/CTCErrorEvaluator.cpp).  Feed it
+    decoded id sequences + references via :meth:`update`."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self, executor=None):
+        self._dist = 0
+        self._len = 0
+        self._seq_errors = 0
+        self._seqs = 0
+
+    def update(self, decoded, references):
+        for d, r in zip(decoded, references):
+            dist = edit_distance(d, r)
+            self._dist += dist
+            self._len += max(len(r), 1)
+            self._seqs += 1
+            self._seq_errors += int(dist > 0)
+
+    def eval(self, executor=None):
+        return self._dist / max(self._len, 1)
+
+    def sequence_error_rate(self):
+        return self._seq_errors / max(self._seqs, 1)

@@ -1,0 +1,268 @@
+"""Sequence generation: the v1 `beam_search(step, GeneratedInput, ...)`
+workflow.
+
+Reference: RecurrentGradientMachine's generation mode —
+`generateSequence`/`beamSearch` (gserver/gradientmachines/
+RecurrentGradientMachine.cpp:964,1439) driven by the config's
+`beam_search(step=..., input=[..., GeneratedInput(...)])`
+(trainer_config_helpers/layers.py) and surfaced through
+`paddle.v2.inference.infer` / SWIG `SequenceGenerator`
+(api/PaddleAPI.h:546).
+
+Architecture (same split as the reference): the per-step subnet runs on
+the accelerator as one compiled program — embedding of the previous
+token + linked memories + static encoder context in, next-token
+distribution + new memories out — while beam bookkeeping (expand,
+prune, eos handling) runs host-side.  Beams ride the batch dimension,
+so one step program invocation advances every beam at once on the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+class GeneratedInput:
+    """The self-feeding decoder input (reference: GeneratedInput in
+    trainer_config_helpers — embedding of the previously generated
+    word, shared with the training-time target embedding by name)."""
+
+    def __init__(self, size: int, embedding_name: str, embedding_size: int):
+        self.size = size                     # target vocabulary size
+        self.embedding_name = embedding_name
+        self.embedding_size = embedding_size
+
+
+class BeamGen:
+    """Deferred generation spec returned by v1 ``beam_search``; consumed
+    by ``SequenceGenerator`` (and v2 ``infer``)."""
+
+    def __init__(self, step, inputs, bos_id, eos_id, beam_size, max_length,
+                 name=None):
+        from paddle_tpu.trainer_config_helpers.layers import (StaticInput,
+                                                              _GROUP_STACK)
+        from paddle_tpu.v2.layer import LayerOutput, _uname
+
+        self.bos_id, self.eos_id = int(bos_id), int(eos_id)
+        self.beam_size, self.max_length = int(beam_size), int(max_length)
+        self.name = name
+        self.static_ins = [i for i in inputs if isinstance(i, StaticInput)]
+        gens = [i for i in inputs if isinstance(i, GeneratedInput)]
+        if len(gens) != 1:
+            raise ValueError("beam_search needs exactly one GeneratedInput")
+        self.gen = gens[0]
+
+        # config-time step invocation with placeholders (same trick as
+        # recurrent_group): placeholder order mirrors the input list
+        self._static_phs = [LayerOutput(_uname("gen_static"), [], None,
+                                        size=s.size) for s in self.static_ins]
+        self._word_ph = LayerOutput(_uname("gen_word"), [], None,
+                                    size=self.gen.embedding_size)
+        phs, si = [], iter(self._static_phs)
+        for i in inputs:
+            phs.append(self._word_ph if isinstance(i, GeneratedInput)
+                       else next(si))
+        self.memories: List = []
+        _GROUP_STACK.append(self.memories)
+        try:
+            out = step(*phs)
+        finally:
+            _GROUP_STACK.pop()
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        self.step_out = out
+
+        # memory-link name map over the step subgraph
+        self._by_name = {}
+
+        def collect(lo, seen):
+            if id(lo) in seen:
+                return
+            seen.add(id(lo))
+            self._by_name[lo.name] = lo
+            for p in lo.parents:
+                collect(p, seen)
+
+        collect(self.step_out, set())
+
+    # mimic enough LayerOutput surface for parameters.create etc.
+    @property
+    def parents(self):
+        return [s.input for s in self.static_ins]
+
+
+class SequenceGenerator:
+    """Builds the init/step programs once and generates with host-side
+    beam search (reference: SWIG SequenceGenerator, api/PaddleAPI.h:546;
+    RecurrentGradientMachine beam loop)."""
+
+    def __init__(self, beam_gen: BeamGen, parameters):
+        from paddle_tpu import executor as executor_mod
+        from paddle_tpu import framework
+        from paddle_tpu import layers as L
+        from paddle_tpu.executor import Executor
+        from paddle_tpu.framework import TPUPlace
+        from paddle_tpu.param_attr import ParamAttr
+        from paddle_tpu.v2.layer import SeqVal
+        from paddle_tpu.v2.trainer import V2DataFeeder
+
+        self.bg = beam_gen
+        self.parameters = parameters
+        self._main = framework.Program()
+        self._startup = framework.Program()
+        with framework.program_guard(self._main, self._startup):
+            ctx = {}
+            static_vals = [s.input.build(ctx) for s in beam_gen.static_ins]
+            from paddle_tpu.v2.topology import normalize_feeds
+
+            self._feed_types = normalize_feeds(ctx.get("@feeds", []))
+            self._feeder = V2DataFeeder(self._feed_types)
+
+            # previous-token embedding, sharing the training-time table
+            word = L.data(name="@gen_word", shape=[-1, 1], dtype="int64",
+                          append_batch_size=False)
+            emb = L.embedding(
+                word, size=[beam_gen.gen.size, beam_gen.gen.embedding_size],
+                param_attr=ParamAttr(name=beam_gen.gen.embedding_name))
+            emb = L.reshape(emb, [-1, beam_gen.gen.embedding_size])
+
+            # memory state feeds + boot exprs
+            self._state_names = []
+            self._boot_vars = []
+            sub_ctx = {id(beam_gen._word_ph): emb}
+            for ph, v in zip(beam_gen._static_phs, static_vals):
+                sub_ctx[id(ph)] = v
+            for i, m in enumerate(beam_gen.memories):
+                sname = f"@gen_state_{i}"
+                sv = L.data(name=sname, shape=[-1, m.size], dtype="float32",
+                            append_batch_size=False)
+                self._state_names.append(sname)
+                sub_ctx[id(m)] = sv
+                if m.parents:
+                    bv = m.parents[0].build(ctx)
+                    bv = bv.var if isinstance(bv, SeqVal) else bv
+                else:
+                    bv = None
+                self._boot_vars.append(bv)
+
+            out = beam_gen.step_out.build(sub_ctx)
+            self._probs_var = out.var if isinstance(out, SeqVal) else out
+            self._new_state_vars = []
+            for m in beam_gen.memories:
+                linked = beam_gen._by_name.get(m._mem_link)
+                if linked is None:
+                    raise KeyError(f"memory link {m._mem_link!r} not found")
+                lv = sub_ctx.get(id(linked))
+                if lv is None:
+                    lv = linked.build(sub_ctx)
+                self._new_state_vars.append(
+                    lv.var if isinstance(lv, SeqVal) else lv)
+
+        self._exe = Executor(TPUPlace())
+        self._scope = parameters.scope
+        # initialize ONLY vars absent from the shared scope: generation
+        # reuses the trained parameters by name (the reference loaded
+        # the merged model by parameter name; clobbering them with the
+        # startup initializers would silently decode from random
+        # weights)
+        blk = self._startup.global_block()
+        blk.ops = [op for op in blk.ops
+                   if any(self._scope.find_var(n) is None
+                          for n in op.output_arg_names)]
+        with executor_mod.scope_guard(self._scope):
+            self._exe.run(self._startup)
+
+    def _run(self, feed, fetch):
+        from paddle_tpu import executor as executor_mod
+
+        with executor_mod.scope_guard(self._scope):
+            return self._exe.run(self._main, feed=feed, fetch_list=fetch)
+
+    def generate(self, row) -> List[tuple]:
+        """Generate for ONE input row (the static-input fields, v2
+        reader order).  Returns the beam as [(score, [ids...]), ...]
+        best-first; ids exclude bos and include eos if produced."""
+        bg = self.bg
+        k = bg.beam_size
+        base = self._feeder.feed([row]) if self._feed_types else {}
+
+        def tile(arr):
+            return np.repeat(np.asarray(arr), k, axis=0)
+
+        feed_k = {n: tile(v) for n, v in base.items()}
+
+        # boot states (computed once from the static feeds, then tiled)
+        states = []
+        boot_fetch = [v for v in self._boot_vars if v is not None]
+        boots = iter(self._run({n: np.asarray(v) for n, v in base.items()},
+                               boot_fetch) if boot_fetch else [])
+        for m, bv in zip(bg.memories, self._boot_vars):
+            if bv is None:
+                states.append(np.zeros((k, m.size), np.float32))
+            else:
+                states.append(tile(np.asarray(next(boots)).reshape(1, -1)))
+
+        tokens = np.full((k, 1), bg.bos_id, np.int64)
+        scores = np.full((k,), -np.inf, np.float32)
+        scores[0] = 0.0                   # identical beams start as one
+        alive = np.ones((k,), bool)
+        seqs = [[] for _ in range(k)]
+
+        for _ in range(bg.max_length):
+            feed = dict(feed_k)
+            feed["@gen_word"] = tokens
+            for n, s in zip(self._state_names, states):
+                feed[n] = s.astype(np.float32)
+            outs = self._run(feed, [self._probs_var] + self._new_state_vars)
+            probs = np.asarray(outs[0]).reshape(k, -1)
+            new_states = [np.asarray(o) for o in outs[1:]]
+            logp = np.log(np.maximum(probs, 1e-20))
+            # dead beams only extend with a frozen no-op
+            total = np.where(alive[:, None], scores[:, None] + logp, -np.inf)
+            flat = total.ravel()
+            V = probs.shape[1]
+            n_alive = int(alive.sum())
+            if n_alive == 0:
+                break
+            top = np.argpartition(-flat, min(k, flat.size - 1))[:k]
+            top = top[np.argsort(-flat[top])]
+            keep_rows = []
+            new_seqs, new_scores, new_alive, new_tokens = [], [], [], []
+            dead = [(scores[i], seqs[i]) for i in range(k) if not alive[i]]
+            for t in top:
+                r, w = divmod(int(t), V)
+                if not np.isfinite(flat[t]):
+                    continue
+                keep_rows.append(r)
+                new_seqs.append(seqs[r] + [w])
+                new_scores.append(flat[t])
+                new_alive.append(w != bg.eos_id)
+                new_tokens.append(w)
+            # pad back to k beams
+            while len(keep_rows) < k:
+                keep_rows.append(0)
+                new_seqs.append(seqs[0])
+                new_scores.append(-np.inf)
+                new_alive.append(False)
+                new_tokens.append(bg.eos_id)
+            # finished beams compete with still-alive ones; keep the
+            # best k of (new + previously dead)
+            pool = list(zip(new_scores, new_seqs, new_alive, keep_rows,
+                            new_tokens)) + [
+                (s, q, False, 0, bg.eos_id) for s, q in dead]
+            pool.sort(key=lambda e: -e[0])
+            pool = pool[:k]
+            scores = np.array([e[0] for e in pool], np.float32)
+            seqs = [e[1] for e in pool]
+            alive = np.array([e[2] for e in pool], bool)
+            rows = [e[3] for e in pool]
+            tokens = np.array([[e[4]] for e in pool], np.int64)
+            states = [s[rows] for s in new_states]
+            if not alive.any():
+                break
+
+        order = np.argsort(-scores)
+        return [(float(scores[i]), list(seqs[i])) for i in order
+                if np.isfinite(scores[i])]

@@ -55,11 +55,20 @@ def fc(
     name: Optional[str] = None,
     **kwargs,
 ):
-    helper = LayerHelper("fc", param_attr=param_attr, bias_attr=bias_attr,
+    inputs_list = _to_list(input)
+    # per-input weight attrs (reference fc_layer accepts a list matched
+    # to the input list)
+    if isinstance(param_attr, (list, tuple)):
+        attrs_list = list(param_attr)
+        assert len(attrs_list) == len(inputs_list), \
+            (len(attrs_list), len(inputs_list))
+    else:
+        attrs_list = [param_attr] * len(inputs_list)
+    helper = LayerHelper("fc", param_attr=None, bias_attr=bias_attr,
                          act=act, name=name, **kwargs)
-    dtype = _to_list(input)[0].dtype
+    dtype = inputs_list[0].dtype
     mul_results = []
-    for inp in _to_list(input):
+    for inp, param_attr in zip(inputs_list, attrs_list):
         in_shape = inp.shape
         if in_shape is None:
             raise ValueError(

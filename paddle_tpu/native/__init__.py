@@ -13,7 +13,8 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__f
 _SRC_DIR = os.path.join(_REPO_ROOT, "native")
 _LIB_PATH = os.path.join(_SRC_DIR, "libpaddle_tpu_native.so")
 _SOURCES = ["recordio.cc", "data_loader.cc", "master_service.cc",
-            "optimizer.cc", "pserver_service.cc", "coord_store.cc"]
+            "optimizer.cc", "pserver_service.cc", "coord_store.cc",
+            "memory.cc"]
 
 _lock = threading.Lock()
 _lib = None
@@ -109,6 +110,17 @@ def lib() -> ctypes.CDLL:
             l.coord_port.restype = ctypes.c_int
             l.coord_port.argtypes = [ctypes.c_void_p]
             l.coord_stop.argtypes = [ctypes.c_void_p]
+            # host staging memory (buddy allocator)
+            l.mem_pool_create.restype = ctypes.c_void_p
+            l.mem_pool_create.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+            l.mem_pool_destroy.argtypes = [ctypes.c_void_p]
+            l.mem_alloc.restype = ctypes.c_void_p
+            l.mem_alloc.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            l.mem_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+            l.mem_used.restype = ctypes.c_uint64
+            l.mem_used.argtypes = [ctypes.c_void_p]
+            l.mem_pool_bytes.restype = ctypes.c_uint64
+            l.mem_pool_bytes.argtypes = [ctypes.c_void_p]
             _lib = l
     return _lib
 

@@ -80,3 +80,63 @@ def _reduce_scatter(ctx):
     except NameError:
         out = unwrap(x)
     ctx.set_output("Out", rewrap(x, out))
+
+
+# ---------------------------------------------------------------------------
+# send / recv: the fluid distributed ops (reference: operators/send_op.cc:30,
+# recv_op.cc:45 — send ships a gradient to a parameter server over gRPC;
+# recv ran the optimizer sub-program server-side and returned the fresh
+# parameter).  Here the server IS the optimizer (native/pserver_service.cc
+# runs the C-ABI optimizer per parameter), so send maps to the GRAD RPC
+# and recv to GET, both via ordered io_callbacks (the XLA side-effect
+# escape hatch) against a process-wide client.
+# ---------------------------------------------------------------------------
+
+_PSERVER_CLIENT = [None]
+
+
+def set_pserver_client(client):
+    """Install the process-wide PServerClient used by send/recv ops
+    (the fluid analog of the reference's gRPC channel setup)."""
+    _PSERVER_CLIENT[0] = client
+
+
+def _client():
+    c = _PSERVER_CLIENT[0]
+    if c is None:
+        raise RuntimeError(
+            "send/recv ops need a pserver: call "
+            "paddle_tpu.ops.collective_ops.set_pserver_client(...) first")
+    return c
+
+
+@register_op("send", inputs=("X",), outputs=(), stop_gradient=True)
+def _send(ctx):
+    from jax.experimental import io_callback
+    import numpy as np
+
+    name = ctx.attr("param_name")
+
+    def host_send(arr):
+        _client().send_grad(name, np.asarray(arr))
+        return np.int32(0)
+
+    io_callback(host_send, jnp.zeros((), jnp.int32),
+                unwrap(ctx.input("X")), ordered=True)
+
+
+@register_op("recv", inputs=("X",), stop_gradient=True)
+def _recv(ctx):
+    from jax.experimental import io_callback
+    import numpy as np
+
+    name = ctx.attr("param_name")
+    x = unwrap(ctx.input("X"))  # shape/dtype template (the local copy)
+
+    def host_recv(template):
+        v = _client().get_param(name).astype(np.float32)
+        return v.reshape(np.asarray(template).shape)
+
+    out = io_callback(host_recv, jax.ShapeDtypeStruct(x.shape, jnp.float32),
+                      x, ordered=True)
+    ctx.set_output("Out", out.astype(x.dtype))

@@ -154,11 +154,14 @@ def _box_coder(ctx):
     ctx.set_output("OutputBox", out)
 
 
-def _nms_single(boxes, scores, score_threshold, nms_threshold, keep):
+def _nms_single(boxes, scores, score_threshold, nms_threshold, keep,
+                iou=None):
     """boxes (M,4), scores (M,) -> (keep,) indices (or -1) by greedy NMS
-    with a fixed iteration count."""
+    with a fixed iteration count.  Pass a precomputed MxM ``iou`` when
+    running per-class over shared boxes."""
     M = boxes.shape[0]
-    iou = _iou(boxes, boxes)
+    if iou is None:
+        iou = _iou(boxes, boxes)
     alive = scores > score_threshold
 
     def body(carry, _):
@@ -195,11 +198,13 @@ def _multiclass_nms(ctx):
     background = int(ctx.attr("background_label", 0))
 
     def one_image(sc, bx):
+        iou = _iou(bx, bx)  # shared across classes
         rows = []
         for c in range(C):
             if c == background:
                 continue
-            picks = _nms_single(bx, sc[c], st, nt, min(per_class, M))
+            picks = _nms_single(bx, sc[c], st, nt, min(per_class, M),
+                                iou=iou)
             ok = picks >= 0
             idx = jnp.maximum(picks, 0)
             rows.append(jnp.concatenate([

@@ -141,3 +141,25 @@ def _modified_huber(ctx):
     ctx.set_output("IntermediateVal", z)
     out = jnp.where(z < -1.0, -4.0 * z, jnp.square(jnp.maximum(1.0 - z, 0.0)))
     ctx.set_output("Out", out)
+
+
+@register_op("padded_sequence_cross_entropy", inputs=("X", "Label", "Length"),
+             diff_inputs=("X",))
+def _padded_sequence_cross_entropy(ctx):
+    """Per-sequence mean NLL over a padded (B, T, V) probability tensor
+    with (B, T) integer labels, masking steps >= Length — the padded
+    analog of per-step cross_entropy over a LoD sequence (reference:
+    operators/cross_entropy_op.cc applied per step of a dynamic RNN)."""
+    x = unwrap(ctx.input("X")).astype(jnp.float32)
+    label = unwrap(ctx.input("Label"))
+    B, T = label.shape[0], label.shape[1]
+    if ctx.has_input("Length"):
+        lens = unwrap(ctx.input("Length")).reshape(-1)
+    else:
+        lens = jnp.full((B,), T, jnp.int32)
+    p = jnp.take_along_axis(x, label[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    nll = -jnp.log(jnp.maximum(p, 1e-12))                 # (B, T)
+    valid = jnp.arange(T)[None, :] < lens[:, None]
+    per_seq = (jnp.sum(jnp.where(valid, nll, 0.0), axis=1)
+               / jnp.maximum(lens.astype(jnp.float32), 1.0))
+    ctx.set_output("Out", per_seq[:, None])

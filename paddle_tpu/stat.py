@@ -1,0 +1,89 @@
+"""Timer/stat registry (reference: paddle/utils/Stat.h:63-233 —
+REGISTER_TIMER/REGISTER_TIMER_INFO accumulate into a global StatSet
+printed per N batches / per pass; enabled with WITH_TIMER).
+
+Host-side timers measure the interpreter/driver path (data feed, feed
+conversion, dispatch); device time belongs to jax.profiler
+(paddle_tpu.profiler) — same split as the reference's Stat vs nvprof.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict
+
+
+class StatItem:
+    __slots__ = ("total", "count", "max")
+
+    def __init__(self):
+        self.total = 0.0
+        self.count = 0
+        self.max = 0.0
+
+    def add(self, dt: float):
+        self.total += dt
+        self.count += 1
+        if dt > self.max:
+            self.max = dt
+
+
+class StatSet:
+    def __init__(self, name: str = "GlobalStatInfo"):
+        self.name = name
+        self._items: Dict[str, StatItem] = {}
+        self._lock = threading.Lock()
+
+    def add(self, key: str, dt: float):
+        with self._lock:
+            self._items.setdefault(key, StatItem()).add(dt)
+
+    def reset(self):
+        with self._lock:
+            self._items.clear()
+
+    def items(self):
+        with self._lock:
+            return dict(self._items)
+
+    def print_status(self, out=None):
+        """The per-pass dump (Stat.h printAllStatus format, simplified)."""
+        import sys
+
+        out = out or sys.stderr
+        rows = sorted(self.items().items(), key=lambda kv: -kv[1].total)
+        print(f"======= StatSet: [{self.name}] =======", file=out)
+        for key, it in rows:
+            avg = it.total / max(it.count, 1)
+            print(f"  {key:<32} total={it.total * 1e3:10.2f}ms "
+                  f"avg={avg * 1e3:8.3f}ms max={it.max * 1e3:8.3f}ms "
+                  f"count={it.count}", file=out)
+
+
+GLOBAL_STATS = StatSet()
+
+
+@contextlib.contextmanager
+def timer(name: str, stats: StatSet = None):
+    """``with stat.timer("forwardBackward"):`` — REGISTER_TIMER."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        (stats or GLOBAL_STATS).add(name, time.perf_counter() - t0)
+
+
+def timed(name: str, stats: StatSet = None):
+    """Decorator form."""
+
+    def deco(fn):
+        def wrapper(*a, **k):
+            with timer(name, stats):
+                return fn(*a, **k)
+
+        wrapper.__name__ = fn.__name__
+        return wrapper
+
+    return deco

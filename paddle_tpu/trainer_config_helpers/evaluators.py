@@ -13,6 +13,7 @@ from paddle_tpu.v2.layer import LayerOutput
 __all__ = [
     "classification_error_evaluator", "auc_evaluator", "chunk_evaluator",
     "precision_recall_evaluator", "pnpair_evaluator",
+    "ctc_error_evaluator", "detection_map_evaluator",
 ]
 
 
@@ -84,3 +85,42 @@ def pnpair_evaluator(input, label, query_id, name=None, **kwargs):
                    out_slot="PositivePair")
 
     return _eval_layer("pnpair", [input, label, query_id], build)
+
+
+def _warn_if_declarative(fn_name):
+    """These two evaluators are host-side accumulators, not in-graph
+    layers; calling them declaratively inside a v1 config would be a
+    silent no-op, unlike the _eval_layer-based siblings."""
+    from paddle_tpu.trainer_config_helpers import layers as _layers
+
+    if _layers._g_capture is not None:
+        import warnings
+
+        warnings.warn(
+            f"{fn_name} is a host-side accumulator: keep the returned "
+            "object and call .update(...) from your event handler; it is "
+            "NOT computed automatically per pass like in-graph "
+            "evaluators", stacklevel=3)
+
+
+def ctc_error_evaluator(input=None, label=None, name=None, **kwargs):
+    """Host-side CTC error accumulator (reference:
+    gserver/evaluators/CTCErrorEvaluator.cpp registered as ctc_edit_distance).
+    Returns the stateful evaluator object; feed decoded/reference id
+    sequences via .update() in the event handler."""
+    from paddle_tpu.evaluator import CTCError
+
+    _warn_if_declarative("ctc_error_evaluator")
+    return CTCError()
+
+
+def detection_map_evaluator(input=None, label=None, overlap_threshold=0.5,
+                            ap_type="11point", name=None, **kwargs):
+    """Detection mAP accumulator (reference:
+    gserver/evaluators/DetectionMAPEvaluator.cpp)."""
+    from paddle_tpu.evaluator import DetectionMAP
+
+    _warn_if_declarative("detection_map_evaluator")
+    return DetectionMAP(overlap_threshold=overlap_threshold,
+                        ap_version="integral" if ap_type == "Integral"
+                        else ap_type)

@@ -73,7 +73,16 @@ def _act_name(act):
 # ---------------------------------------------------------------------------
 
 
+_DATA_DECL_COUNTER = [0]
+
+
 def data(name: str, type: InputType, **kwargs) -> LayerOutput:
+    # feed columns follow DECLARATION order (the reference's config
+    # order), not graph build order — a recurrent group can build its
+    # sequence inputs before earlier-declared static ones
+    decl_order = _DATA_DECL_COUNTER[0]
+    _DATA_DECL_COUNTER[0] += 1
+
     def build(ctx):
         from paddle_tpu import layers as L
 
@@ -88,11 +97,11 @@ def data(name: str, type: InputType, **kwargs) -> LayerOutput:
                 var.shape = (-1, -1, type.dim)
             lens = L.data(name=name + "@len", shape=[-1], dtype="int32",
                           append_batch_size=False)
-            ctx.setdefault("@feeds", []).append((name, type))
+            ctx.setdefault("@feeds", []).append((name, type, decl_order))
             return SeqVal(var, lens)
         shape = [type.dim] if type.dtype != "int64" else [1]
         var = L.data(name=name, shape=shape, dtype=type.dtype)
-        ctx.setdefault("@feeds", []).append((name, type))
+        ctx.setdefault("@feeds", []).append((name, type, decl_order))
         return var
 
     return LayerOutput(name, [], build, size=type.dim, is_seq=type.is_seq,
@@ -335,7 +344,20 @@ def simple_rnn(input, size: int, act=None, reverse: bool = False, name=None,
 def cross_entropy_cost(input, label, name=None, **kwargs):
     def build(ctx, pred, lab):
         from paddle_tpu import layers as L
+        from paddle_tpu.layer_helper import LayerHelper
 
+        if isinstance(pred, SeqVal):
+            # per-step CE over the padded sequence, masked by length
+            # (reference: per-step cost inside a RecurrentLayerGroup)
+            helper = LayerHelper("seq_ce")
+            out = helper.create_tmp_variable("float32", (-1, 1))
+            ins = {"X": [pred.var],
+                   "Label": [lab.var if isinstance(lab, SeqVal) else lab]}
+            if pred.lengths is not None:
+                ins["Length"] = [pred.lengths]
+            helper.append_op(type="padded_sequence_cross_entropy",
+                             inputs=ins, outputs={"Out": [out]})
+            return L.mean(out)
         ce = L.cross_entropy(input=pred, label=lab)
         return L.mean(ce)
 

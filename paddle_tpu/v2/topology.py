@@ -38,10 +38,22 @@ class Topology:
             framework._name_gen = saved_gen
         self.cost_var = self.output_vars[0] if cost is not None else None
         # (name, InputType) in declaration order
-        self.feed_types = list(self.ctx.get("@feeds", []))
+        self.feed_types = normalize_feeds(self.ctx.get("@feeds", []))
 
     def data_layers(self):
         return {name: t for name, t in self.feed_types}
 
     def feed_names(self):
         return [name for name, _ in self.feed_types]
+
+
+def normalize_feeds(entries):
+    """(name, type[, decl_order]) entries -> [(name, type)] in
+    declaration order, deduped by name."""
+    seen = {}
+    for e in entries:
+        name, t = e[0], e[1]
+        order = e[2] if len(e) > 2 else len(seen)
+        if name not in seen:
+            seen[name] = (order, t)
+    return [(n, t) for n, (o, t) in sorted(seen.items(), key=lambda kv: kv[1][0])]

@@ -39,7 +39,16 @@ def main(argv=None):
         a = argv.pop(0)
         if a == "--":
             break
-        k, _, v = a[2:].partition("=")
+        k, eq, v = a[2:].partition("=")
+        if not eq:  # space-separated form: --pservers 2
+            if not argv or argv[0].startswith("--"):
+                print(f"missing value for --{k}", file=sys.stderr)
+                return 2
+            v = argv.pop(0)
+        if k not in opts:
+            print(f"unknown option --{k}; known: {sorted(opts)}",
+                  file=sys.stderr)
+            return 2
         opts[k] = v
     trainer_cmd = argv
     if not trainer_cmd:

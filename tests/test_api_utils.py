@@ -1,0 +1,103 @@
+"""SWIG-api compat + utils parity tests (reference: api/PaddleAPI.h
+surface; utils/Stat.h timers; utils/Flags.cpp gflags;
+platform/enforce.h; gserver CTCErrorEvaluator)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.v2 as paddle
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    fluid.framework.reset_default_programs()
+    paddle.init(use_gpu=False, trainer_count=1)
+    yield
+
+
+def test_gradient_machine_forward_backward():
+    from paddle_tpu import api
+
+    api.initPaddle("--use_gpu=false", "--trainer_count=1")
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    y = paddle.layer.data(name="y", type=paddle.data_type.dense_vector(1))
+    pred = paddle.layer.fc(input=x, size=1, bias_attr=False)
+    cost = paddle.layer.mse_cost(input=pred, label=y)
+    gm = api.GradientMachine.createFromConfigProto(cost)
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(8, 4).astype(np.float32)
+    ys = rng.randn(8, 1).astype(np.float32)
+    in_args = api.Arguments.createArguments(2)
+    in_args.setSlotValue(0, xs)
+    in_args.setSlotValue(1, ys)
+    out_args = api.Arguments.createArguments(0)
+    loss = gm.forwardBackward(in_args, out_args)
+    # gradient of mse wrt W: 2/N x^T (xW - y)
+    params = gm.getParameters()
+    w = params.get(list(params.keys())[0])
+    want = 2.0 / 8 * xs.T @ (xs @ w - ys)
+    np.testing.assert_allclose(gm._last_grads[list(params.keys())[0]], want,
+                               rtol=1e-4, atol=1e-5)
+    assert np.isfinite(float(np.asarray(out_args.getSlotValue(0)).ravel()[0]))
+
+
+def test_arguments_slots():
+    from paddle_tpu.api import Arguments
+
+    a = Arguments.createArguments(2)
+    a.setSlotValue(0, np.ones((2, 3)))
+    a.setSlotIds(1, [1, 2, 3])
+    a.setSlotSequenceStartPositions(1, [2, 1])
+    assert a.getSlotValue(0).shape == (2, 3)
+    assert a.getSlotIds(1).dtype == np.int64
+    assert list(a.getSlotSequenceStartPositions(1)) == [2, 1]
+
+
+def test_flags_registry():
+    from paddle_tpu.flags import FLAGS, init_gflags
+
+    assert FLAGS.trainer_count == 1
+    rest = init_gflags(["--trainer_count=4", "--use_gpu=true", "positional"])
+    assert rest == ["positional"]
+    assert FLAGS.trainer_count == 4 and FLAGS.use_gpu is True
+    FLAGS.set("trainer_count", 1)
+    FLAGS.set("use_gpu", False)
+
+
+def test_stat_timers():
+    import time
+
+    from paddle_tpu.stat import StatSet, timer
+
+    s = StatSet("test")
+    for _ in range(3):
+        with timer("op", stats=s):
+            time.sleep(0.002)
+    it = s.items()["op"]
+    assert it.count == 3 and it.total >= 0.006
+    import io
+
+    buf = io.StringIO()
+    s.print_status(out=buf)
+    assert "op" in buf.getvalue()
+
+
+def test_enforce():
+    from paddle_tpu.errors import EnforceNotMet, PaddleError, enforce
+
+    enforce(True, "fine")
+    with pytest.raises(EnforceNotMet):
+        enforce(False, "dim mismatch %d vs %d", 3, 4)
+    assert issubclass(EnforceNotMet, PaddleError)
+
+
+def test_ctc_error_evaluator():
+    from paddle_tpu.trainer_config_helpers.evaluators import ctc_error_evaluator
+
+    ev = ctc_error_evaluator()
+    ev.update([[1, 2, 3], [4, 5]], [[1, 2, 3], [4, 6, 5]])
+    # distances: 0 and 1; total ref len 6
+    assert abs(ev.eval() - 1 / 6) < 1e-9
+    assert abs(ev.sequence_error_rate() - 0.5) < 1e-9

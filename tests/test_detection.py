@@ -200,8 +200,6 @@ def test_ctc_empty_label():
     labels[0, :2] = [1, 2]
     label_lens = np.array([2, 0], np.int64)
     logit_lens = np.array([6, 6], np.int64)
-    import sys
-    sys.path.insert(0, "tests")
     from test_ctc_hsig_fm import _run_ctc
 
     fluid.framework.reset_default_programs()

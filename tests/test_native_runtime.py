@@ -167,3 +167,63 @@ def test_concurrent_trainers(shards):
             t.join()
         assert sorted(results) == sorted(f"t{i}" for i in range(40))
         main.close()
+
+
+def test_buddy_allocator_alloc_free_used():
+    """Alloc/Free/Used contract (reference: memory/memory.h:36-55 over
+    memory/detail/buddy_allocator.cc)."""
+    import ctypes
+
+    from paddle_tpu.native import lib
+
+    l = lib()
+    pool = l.mem_pool_create(1 << 20, 0)  # 1 MiB chunks
+    assert l.mem_used(pool) == 0
+    p1 = l.mem_alloc(pool, 1000)     # rounds to 1024
+    p2 = l.mem_alloc(pool, 1000)
+    assert p1 and p2 and p1 != p2
+    assert l.mem_used(pool) == 2048
+    # writeable
+    ctypes.memset(p1, 0xAB, 1000)
+    l.mem_free(pool, p1)
+    assert l.mem_used(pool) == 1024
+    # freed block is reused (same or buddy address class)
+    p3 = l.mem_alloc(pool, 512)
+    assert p3
+    l.mem_free(pool, p2)
+    l.mem_free(pool, p3)
+    assert l.mem_used(pool) == 0
+    l.mem_pool_destroy(pool)
+
+
+def test_buddy_allocator_coalescing():
+    """Freeing both buddies coalesces so a max-size block fits again."""
+    from paddle_tpu.native import lib
+
+    l = lib()
+    chunk = 1 << 16
+    pool = l.mem_pool_create(chunk, chunk)  # exactly one chunk allowed
+    halves = [l.mem_alloc(pool, chunk // 2) for _ in range(2)]
+    assert all(halves)
+    assert not l.mem_alloc(pool, chunk // 2)  # pool exhausted, no grow
+    for h in halves:
+        l.mem_free(pool, h)
+    # buddies merged back: a full-chunk allocation succeeds in-pool
+    whole = l.mem_alloc(pool, chunk)
+    assert whole
+    assert l.mem_pool_bytes(pool) == chunk
+    l.mem_free(pool, whole)
+    l.mem_pool_destroy(pool)
+
+
+def test_buddy_allocator_oversize_fallback():
+    from paddle_tpu.native import lib
+
+    l = lib()
+    pool = l.mem_pool_create(1 << 16, 1 << 16)
+    big = l.mem_alloc(pool, 1 << 20)   # > chunk: system fallback
+    assert big
+    assert l.mem_used(pool) == 1 << 20
+    l.mem_free(pool, big)
+    assert l.mem_used(pool) == 0
+    l.mem_pool_destroy(pool)

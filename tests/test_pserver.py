@@ -344,3 +344,39 @@ def test_opt_rmsprop_and_unknown_type():
     np.testing.assert_allclose(
         _weights(l2, h), [1.0 - 0.1 * 2.0 / (np.sqrt(ms) + 1e-6)], rtol=1e-5)
     l2.opt_destroy(h)
+
+
+def test_send_recv_ops_in_graph():
+    """fluid send/recv ops against a live pserver: the compiled program
+    ships the grad and pulls the fresh parameter via io_callbacks
+    (reference: operators/send_op.cc + recv_op.cc over gRPC)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.ops.collective_ops import set_pserver_client
+
+    fluid.framework.reset_default_programs()
+    with ParameterServer() as ps:
+        with PServerClient([ps.address]) as c:
+            c.init_param("w", np.zeros(4, np.float32),
+                         optimizer="type=sgd lr=1.0")
+            c.finish_init()
+            set_pserver_client(c)
+            try:
+                g = fluid.layers.data(name="g", shape=[4],
+                                      dtype="float32",
+                                      append_batch_size=False)
+                helper_block = fluid.default_main_program().global_block()
+                helper_block.append_op(type="send", inputs={"X": [g]},
+                                       outputs={}, attrs={"param_name": "w"})
+                out = helper_block.create_var(name="w_fresh", shape=(4,),
+                                              dtype="float32")
+                helper_block.append_op(type="recv", inputs={"X": [g]},
+                                       outputs={"Out": [out]},
+                                       attrs={"param_name": "w"})
+                exe = fluid.Executor(fluid.CPUPlace())
+                (fresh,) = exe.run(
+                    feed={"g": np.ones(4, np.float32)},
+                    fetch_list=[out])
+                np.testing.assert_allclose(np.asarray(fresh),
+                                           -np.ones(4), rtol=1e-6)
+            finally:
+                set_pserver_client(None)

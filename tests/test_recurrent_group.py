@@ -1,0 +1,305 @@
+"""recurrent_group / memory facade tests (reference:
+gserver/tests/test_RecurrentGradientMachine.cpp + the
+sequence_rnn.conf / sequence_nest_rnn.conf config suite: a
+recurrent_group with an explicit step must match the equivalent fused
+recurrent layer / manual loop)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.v2 as paddle
+import paddle_tpu as fluid
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    fluid.framework.reset_default_programs()
+    paddle.init(use_gpu=False, trainer_count=1)
+    yield
+
+
+def _build_group_rnn(hidden):
+    from paddle_tpu.trainer_config_helpers import (
+        data_layer, fc_layer, memory, recurrent_group, LinearActivation,
+        TanhActivation)
+
+    seq = data_layer(name="seq", size=4)
+
+    def step(x_t):
+        mem = memory(name="h", size=hidden)
+        return fc_layer(input=[x_t, mem], size=hidden,
+                        act=TanhActivation(), name="h", bias_attr=False)
+
+    return seq, recurrent_group(step=step, input=seq)
+
+
+def test_group_matches_manual_rnn():
+    """fc([x_t, h_{t-1}]) recurrent_group == the numpy loop."""
+    from paddle_tpu.trainer_config_helpers import outputs  # noqa: F401
+    from paddle_tpu.v2.topology import Topology
+    from paddle_tpu.v2 import parameters as v2p
+
+    hidden = 8
+    # sequence input type for data_layer comes from the v1 DSL; the
+    # simplest path is via the v2 facade objects directly:
+    x = paddle.layer.data(name="x",
+                          type=paddle.data_type.dense_vector_sequence(4))
+    from paddle_tpu.trainer_config_helpers import (memory, recurrent_group,
+                                                   TanhActivation)
+    import paddle_tpu.v2.layer as _v2l
+
+    def step(x_t):
+        mem = memory(name="h", size=hidden)
+        return _v2l.fc(input=[x_t, mem], size=hidden, act="tanh",
+                       name="h", bias_attr=False)
+
+    out = recurrent_group(step=step, input=x)
+    pooled = paddle.layer.pooling(input=out,
+                                  pooling_type=paddle.pooling.Max())
+    params = paddle.parameters.create(pooled)
+
+    rng = np.random.RandomState(0)
+    batch = [[rng.randn(5, 4).astype(np.float32).tolist()],
+             [rng.randn(3, 4).astype(np.float32).tolist()]]
+    from paddle_tpu.v2.inference import Inference
+
+    inf = Inference(out, params)
+    got = np.asarray(inf.infer(batch))
+
+    # manual loop with the learned weights (two fc inputs share one
+    # concatenated weight? no — fc over list = sum of muls)
+    names = sorted(params.keys())
+    w_x = params.get(names[0])
+    w_h = params.get(names[1])
+    if w_x.shape[0] != 4:
+        w_x, w_h = w_h, w_x
+    for b, rows in enumerate([batch[0][0], batch[1][0]]):
+        h = np.zeros(hidden, np.float32)
+        for t, r in enumerate(rows):
+            h = np.tanh(np.asarray(r, np.float32) @ w_x + h @ w_h)
+            np.testing.assert_allclose(got[b, t], h, rtol=1e-4, atol=1e-5)
+
+
+def test_group_with_static_input_and_boot():
+    """StaticInput is visible unsliced every step; boot_layer seeds the
+    memory."""
+    from paddle_tpu.trainer_config_helpers import (memory, recurrent_group,
+                                                   StaticInput)
+    import paddle_tpu.v2.layer as _v2l
+
+    hidden = 6
+    x = paddle.layer.data(name="x",
+                          type=paddle.data_type.dense_vector_sequence(3))
+    ctxv = paddle.layer.data(name="ctx",
+                             type=paddle.data_type.dense_vector(hidden))
+    boot = paddle.layer.data(name="boot",
+                             type=paddle.data_type.dense_vector(hidden))
+
+    def step(x_t, c):
+        mem = memory(name="h", size=hidden, boot_layer=boot)
+        return _v2l.fc(input=[x_t, mem, c], size=hidden, act="tanh",
+                       name="h", bias_attr=False)
+
+    out = recurrent_group(step=step,
+                          input=[x, StaticInput(ctxv, size=hidden)])
+    params = paddle.parameters.create(
+        paddle.layer.pooling(input=out,
+                             pooling_type=paddle.pooling.Max()))
+    from paddle_tpu.v2.inference import Inference
+
+    rng = np.random.RandomState(1)
+    seq = rng.randn(4, 3).astype(np.float32)
+    cvec = rng.randn(hidden).astype(np.float32)
+    bvec = rng.randn(hidden).astype(np.float32)
+    inf = Inference(out, params)
+    got = np.asarray(inf.infer([[seq.tolist(), cvec.tolist(), bvec.tolist()]],
+                               feeding={"x": 0, "ctx": 1, "boot": 2}))
+
+    names = sorted(params.keys())
+    ws = {params.get(n).shape[0]: params.get(n) for n in names}
+    w_x, w_h, w_c = ws[3], None, None
+    hs = [params.get(n) for n in names if params.get(n).shape[0] == hidden]
+    # disambiguate h vs c weight by zeroing test: instead reconstruct via
+    # order of creation: fc input order is [x_t, mem, c]
+    w_x = params.get(names[0]); w_h = params.get(names[1]); w_c = params.get(names[2])
+    if w_x.shape[0] != 3:
+        raise AssertionError("unexpected parameter order")
+    h = bvec.copy()
+    for t in range(4):
+        h = np.tanh(seq[t] @ w_x + h @ w_h + cvec @ w_c)
+        np.testing.assert_allclose(got[0, t], h, rtol=1e-4, atol=1e-5)
+
+
+def test_group_trains_end_to_end():
+    """recurrent_group output feeds a classifier and the whole thing
+    trains (gradients flow through the scan + memory links)."""
+    from paddle_tpu.trainer_config_helpers import memory, recurrent_group
+    import paddle_tpu.v2.layer as _v2l
+
+    hidden, nclass = 12, 3
+    x = paddle.layer.data(name="x",
+                          type=paddle.data_type.dense_vector_sequence(6))
+    y = paddle.layer.data(name="y",
+                          type=paddle.data_type.integer_value(nclass))
+
+    def step(x_t):
+        mem = memory(name="h", size=hidden)
+        return _v2l.fc(input=[x_t, mem], size=hidden, act="tanh", name="h")
+
+    seq_h = recurrent_group(step=step, input=x)
+    last = paddle.layer.last_seq(input=seq_h)
+    pred = paddle.layer.fc(input=last, size=nclass, act="softmax")
+    cost = paddle.layer.classification_cost(input=pred, label=y)
+    params = paddle.parameters.create(cost)
+    tr = paddle.trainer.SGD(cost=cost, parameters=params,
+                            update_equation=paddle.optimizer.Adam(
+                                learning_rate=0.02))
+    rng = np.random.RandomState(2)
+    protos = rng.randn(nclass, 6).astype(np.float32)
+
+    def reader():
+        for _ in range(40):
+            k = int(rng.randint(0, nclass))
+            T = int(rng.randint(3, 7))
+            seq = protos[k] + 0.1 * rng.randn(T, 6).astype(np.float32)
+            yield seq.tolist(), k
+
+    costs = []
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration):
+            costs.append(e.cost)
+
+    tr.train(paddle.batch(reader, batch_size=8), num_passes=6,
+             event_handler=handler)
+    assert np.mean(costs[-3:]) < 0.5 * np.mean(costs[:3]), (
+        costs[:3], costs[-3:])
+
+
+def test_beam_search_generation_end_to_end():
+    """Train a decoder with recurrent_group (teacher forced), then
+    generate with beam_search + SequenceGenerator sharing parameters by
+    name — the RecurrentGradientMachine generation workflow
+    (RecurrentGradientMachine.cpp:964 generateSequence)."""
+    from paddle_tpu.trainer_config_helpers import (GeneratedInput,
+                                                   StaticInput, beam_search,
+                                                   memory, recurrent_group)
+    from paddle_tpu.generation import SequenceGenerator
+    import paddle_tpu.v2.layer as _v2l
+
+    V, E, H = 8, 12, 16
+    BOS, EOS = 0, 1
+
+    def decoder_step(word_emb, ctxv):
+        mem = memory(name="dec_h", size=H)
+        h = _v2l.fc(input=[word_emb, mem, ctxv], size=H, act="tanh",
+                    name="dec_h",
+                    param_attr=[paddle.attr.Param(name="w_in"),
+                                paddle.attr.Param(name="w_rec"),
+                                paddle.attr.Param(name="w_ctx")],
+                    bias_attr=False)
+        return _v2l.fc(input=h, size=V, act="softmax", name="dec_out",
+                       param_attr=paddle.attr.Param(name="w_out"),
+                       bias_attr=False)
+
+    # --- training: teacher-forced over the target sequence ---
+    ctxv = paddle.layer.data(name="ctx", type=paddle.data_type.dense_vector(H))
+    tin = paddle.layer.data(
+        name="tin", type=paddle.data_type.integer_value_sequence(V))
+    tout = paddle.layer.data(
+        name="tout", type=paddle.data_type.integer_value_sequence(V))
+    temb = paddle.layer.embedding(
+        input=tin, size=E, param_attr=paddle.attr.Param(name="tgt_emb"))
+    probs = recurrent_group(step=decoder_step,
+                            input=[temb, StaticInput(ctxv, size=H)])
+    cost = paddle.layer.classification_cost(input=probs, label=tout)
+    params = paddle.parameters.create(cost)
+    tr = paddle.trainer.SGD(cost=cost, parameters=params,
+                            update_equation=paddle.optimizer.Adam(
+                                learning_rate=0.02))
+
+    # task: context vector k (one-hot-ish) -> emit [k+2, k+2, EOS]
+    rng = np.random.RandomState(3)
+    ctx_protos = np.eye(H, dtype=np.float32)[:3] * 2.0
+
+    def reader():
+        for _ in range(60):
+            k = int(rng.randint(0, 3))
+            tgt = [k + 2, k + 2, EOS]
+            yield ctx_protos[k].tolist(), [BOS] + tgt[:-1], tgt
+
+    costs = []
+    tr.train(paddle.batch(reader, batch_size=12), num_passes=8,
+             event_handler=lambda e: costs.append(e.cost) if isinstance(
+                 e, paddle.event.EndIteration) else None)
+    assert costs[-1] < 0.5 * costs[0], (costs[0], costs[-1])
+
+    # --- generation: same step fn, same parameter names ---
+    gen_ctx = paddle.layer.data(name="ctx",
+                                type=paddle.data_type.dense_vector(H))
+    # input order is positional wrt the step signature (reference:
+    # seqToseq gen config lists inputs in the step's argument order)
+    bg = beam_search(step=decoder_step,
+                     input=[GeneratedInput(size=V, embedding_name="tgt_emb",
+                                           embedding_size=E),
+                            StaticInput(gen_ctx, size=H)],
+                     bos_id=BOS, eos_id=EOS, beam_size=3, max_length=6)
+    gen = SequenceGenerator(bg, params)
+    for k in range(3):
+        beams = gen.generate([ctx_protos[k].tolist()])
+        assert beams, "no finished beams"
+        score, ids = beams[0]
+        assert ids == [k + 2, k + 2, EOS], (k, beams[:2])
+
+
+def test_attention_decoder_in_recurrent_group():
+    """The canonical NMT decoder composition: recurrent_group whose
+    step runs simple_attention over a whole-sequence StaticInput
+    (reference: networks.py simple_attention used inside
+    gru_decoder_with_attention in the seqToseq configs)."""
+    from paddle_tpu.trainer_config_helpers import (StaticInput, memory,
+                                                   recurrent_group)
+    from paddle_tpu.trainer_config_helpers.networks import simple_attention
+    import paddle_tpu.v2.layer as _v2l
+
+    H, E, nclass = 8, 6, 4
+    enc = paddle.layer.data(name="enc",
+                            type=paddle.data_type.dense_vector_sequence(H))
+    tgt = paddle.layer.data(name="tgt",
+                            type=paddle.data_type.dense_vector_sequence(E))
+    lab = paddle.layer.data(
+        name="lab", type=paddle.data_type.integer_value_sequence(nclass))
+
+    def step(word, enc_seq):
+        dec_mem = memory(name="dec", size=H)
+        ctxv = simple_attention(encoded_sequence=enc_seq,
+                                encoded_proj=enc_seq,
+                                decoder_state=dec_mem)
+        return _v2l.fc(input=[word, ctxv, dec_mem], size=H, act="tanh",
+                       name="dec", bias_attr=False)
+
+    dec = recurrent_group(step=step,
+                          input=[tgt, StaticInput(enc, is_seq=True, size=H)])
+    pred = paddle.layer.fc(input=dec, size=nclass, act="softmax")
+    cost = paddle.layer.classification_cost(input=pred, label=lab)
+    params = paddle.parameters.create(cost)
+    tr = paddle.trainer.SGD(cost=cost, parameters=params,
+                            update_equation=paddle.optimizer.Adam(
+                                learning_rate=0.02))
+    rng = np.random.RandomState(4)
+
+    def reader():
+        for _ in range(30):
+            Ts, Td = int(rng.randint(3, 6)), int(rng.randint(2, 5))
+            k = int(rng.randint(0, nclass))
+            e = (np.eye(H, dtype=np.float32)[k] + 
+                 0.1 * rng.randn(Ts, H)).astype(np.float32)
+            t = rng.randn(Td, E).astype(np.float32)
+            yield e.tolist(), t.tolist(), [k] * Td
+
+    costs = []
+    tr.train(paddle.batch(reader, batch_size=8), num_passes=12,
+             event_handler=lambda ev: costs.append(ev.cost) if isinstance(
+                 ev, paddle.event.EndIteration) else None)
+    assert np.mean(costs[-3:]) < 0.4 * np.mean(costs[:3]), (
+        costs[:3], costs[-3:])
