@@ -23,7 +23,7 @@ from paddle_tpu.trainer_config_helpers.poolings import (BasePoolingType,
                                                         MaxPooling)
 from paddle_tpu.v2 import data_type as _dt
 from paddle_tpu.v2 import layer as _v2
-from paddle_tpu.v2.layer import LayerOutput, SeqVal
+from paddle_tpu.v2.layer import LayerOutput, SeqVal, SubSeqVal
 from paddle_tpu.generation import GeneratedInput  # noqa: F401
 
 __all__ = [
@@ -900,17 +900,28 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
         k, k2 = len(seq_ins), len(seq_ins) + len(static_ins)
         seq_vals, static_vals = vals[:k], vals[k:k2]
         boot_vals = list(vals[k2:])
-        lengths = next((v.lengths for v in seq_vals if isinstance(v, SeqVal)),
-                       None)
+        lengths = next((v.lengths for v in seq_vals
+                        if isinstance(v, (SeqVal, SubSeqVal))), None)
         rnn = L.StaticRNN()
         rnn._reverse = reverse
         with rnn.step():
             sub_ctx = {}
             first_in = None
             for ph, sv in zip(placeholders, seq_vals):
-                stv = rnn.step_input(sv.var if isinstance(sv, SeqVal) else sv)
-                first_in = first_in if first_in is not None else stv
-                sub_ctx[id(ph)] = stv
+                if isinstance(sv, SubSeqVal):
+                    # nested sequence: each outer step sees one whole
+                    # subsequence as a (B, T, ...) SeqVal (reference:
+                    # nested RecurrentLayerGroup over sub-sequences,
+                    # sequence_nest_rnn.conf)
+                    dstep = rnn.step_input(sv.var)
+                    lstep = rnn.step_input(sv.sub_lengths)
+                    first_in = first_in if first_in is not None else dstep
+                    sub_ctx[id(ph)] = SeqVal(dstep, lstep)
+                else:
+                    stv = rnn.step_input(
+                        sv.var if isinstance(sv, SeqVal) else sv)
+                    first_in = first_in if first_in is not None else stv
+                    sub_ctx[id(ph)] = stv
             for ph, v in zip(static_phs, static_vals):
                 # sequence statics keep their SeqVal wrapper so in-step
                 # sequence layers (attention etc.) see the lengths; the

@@ -27,12 +27,23 @@ def dense_vector_sequence(dim):
     return dense_vector(dim, 1)
 
 
+def dense_vector_sub_sequence(dim):
+    """2-level nested sequence (reference: seq_type=2 — the LoD-level-2
+    machinery of framework/lod_tensor.h:58 / Argument
+    subSequenceStartPositions)."""
+    return dense_vector(dim, 2)
+
+
 def integer_value(range_, seq_type=0):
     return InputType(range_, seq_type, "int64")
 
 
 def integer_value_sequence(range_):
     return integer_value(range_, 1)
+
+
+def integer_value_sub_sequence(range_):
+    return integer_value(range_, 2)
 
 
 def sparse_binary_vector(dim, seq_type=0):

@@ -39,6 +39,18 @@ class SeqVal:
         self.lengths = lengths
 
 
+class SubSeqVal:
+    """A padded 2-level nested sequence: (B, S, T, ...) data, outer
+    lengths (B,) = #subsequences, inner lengths (B, S) = steps per
+    subsequence (reference: LoD level-2, framework/lod_tensor.h:58;
+    Argument::subSequenceStartPositions)."""
+
+    def __init__(self, var, lengths, sub_lengths):
+        self.var = var
+        self.lengths = lengths          # (B,)
+        self.sub_lengths = sub_lengths  # (B, S)
+
+
 class LayerOutput:
     def __init__(self, name: str, parents: List["LayerOutput"],
                  build_fn: Callable, size: Optional[int] = None,
@@ -86,6 +98,21 @@ def data(name: str, type: InputType, **kwargs) -> LayerOutput:
     def build(ctx):
         from paddle_tpu import layers as L
 
+        if type.seq_type == 2:
+            if type.dtype == "int64":
+                var = L.data(name=name, shape=[-1, -1], dtype="int64",
+                             append_batch_size=False)
+                var.shape = (-1, -1, -1)  # (B, S, T)
+            else:
+                var = L.data(name=name, shape=[-1, -1, type.dim],
+                             dtype=type.dtype, append_batch_size=False)
+                var.shape = (-1, -1, -1, type.dim)
+            lens = L.data(name=name + "@len", shape=[-1], dtype="int32",
+                          append_batch_size=False)
+            sublens = L.data(name=name + "@sublen", shape=[-1, -1],
+                             dtype="int32", append_batch_size=False)
+            ctx.setdefault("@feeds", []).append((name, type, decl_order))
+            return SubSeqVal(var, lens, sublens)
         if type.is_seq:
             if type.dtype == "int64":
                 var = L.data(name=name, shape=[-1], dtype="int64",

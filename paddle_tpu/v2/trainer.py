@@ -38,7 +38,33 @@ class V2DataFeeder:
         for col, (name, t) in enumerate(self.feed_types):
             idx = self.feeding[name] if self.feeding else col
             column = [row[idx] for row in minibatch]
-            if t.is_seq:
+            if getattr(t, "seq_type", 0) == 2:
+                # nested sequence: list of subsequences per row ->
+                # (B, S, T[, dim]) + outer lens (B,) + inner lens (B, S)
+                B = len(column)
+                outer = np.asarray([len(r) for r in column], np.int32)
+                S = _round_up(max(int(outer.max()), 1), 1)
+                inner = np.zeros((B, S), np.int32)
+                maxT = 1
+                for i, r in enumerate(column):
+                    for j, sub in enumerate(r):
+                        inner[i, j] = len(sub)
+                        maxT = max(maxT, len(sub))
+                T = _round_up(maxT, self.time_bucket)
+                if t.dtype == "int64":
+                    arr = np.zeros((B, S, T), np.int64)
+                    for i, r in enumerate(column):
+                        for j, sub in enumerate(r):
+                            arr[i, j, :len(sub)] = np.asarray(sub, np.int64)
+                else:
+                    arr = np.zeros((B, S, T, t.dim), np.float32)
+                    for i, r in enumerate(column):
+                        for j, sub in enumerate(r):
+                            arr[i, j, :len(sub)] = np.asarray(sub, np.float32)
+                out[name] = arr
+                out[name + "@len"] = outer
+                out[name + "@sublen"] = inner
+            elif t.is_seq:
                 lens = np.asarray([len(c) for c in column], np.int32)
                 T = _round_up(max(int(lens.max()), 1), self.time_bucket)
                 if t.dtype == "int64":
