@@ -45,12 +45,47 @@ def _all_reduce(ctx):
     ctx.set_output("Out", rewrap(x, out))
 
 
-# nccl-style aliases for the reference op names
+# nccl-style aliases for the reference op names (operators/nccl_op.cc:
+# ncclInit/ncclAllReduce/ncclReduce/ncclBcast).  On TPU there is no
+# communicator object to initialize — GSPMD compiles the collective
+# into the program — so ncclInit is a no-op marker and reduce/bcast
+# map to psum (every replica gets the result; the reference's
+# root-only semantics have no SPMD analog) and a root-broadcast.
+@register_op("ncclInit", inputs=(), outputs=(), stop_gradient=True)
+def _nccl_init(ctx):
+    pass
+
+
 @register_op("ncclAllReduce", inputs=("X",))
 def _nccl_all_reduce(ctx):
     x = ctx.input("X")
     try:
         out = lax.psum(unwrap(x), _axis(ctx))
+    except NameError:
+        out = unwrap(x)
+    ctx.set_output("Out", rewrap(x, out))
+
+
+@register_op("ncclReduce", inputs=("X",))
+def _nccl_reduce(ctx):
+    x = ctx.input("X")
+    try:
+        out = lax.psum(unwrap(x), _axis(ctx))
+    except NameError:
+        out = unwrap(x)
+    ctx.set_output("Out", rewrap(x, out))
+
+
+@register_op("ncclBcast", inputs=("X",))
+def _nccl_bcast(ctx):
+    """Root's value to every replica (root attr, default 0)."""
+    x = ctx.input("X")
+    root = int(ctx.attr("root", 0))
+    try:
+        ax = _axis(ctx)
+        idx = lax.axis_index(ax)
+        v = unwrap(x)
+        out = lax.psum(jnp.where(idx == root, v, jnp.zeros_like(v)), ax)
     except NameError:
         out = unwrap(x)
     ctx.set_output("Out", rewrap(x, out))
