@@ -21,6 +21,7 @@ __all__ = [
     "cross_entropy",
     "softmax_with_cross_entropy",
     "sigmoid_cross_entropy_with_logits",
+    "log_loss",
     "square_error_cost",
     "accuracy",
     "topk",
@@ -690,4 +691,16 @@ def factorization_machine(input, factor_size, param_attr=None, **kwargs):
     helper.append_op(type="factorization_machine",
                      inputs={"X": [input], "W": [w]},
                      outputs={"Out": [out]})
+    return out
+
+
+def log_loss(input, label, epsilon: float = 1e-4, **kwargs):
+    """Negative log likelihood of a probability prediction (reference:
+    fluid layers log_loss → operators/log_loss_op.cc)."""
+    helper = LayerHelper("log_loss", **kwargs)
+    out = helper.create_tmp_variable(input.dtype, input.shape, input.lod_level)
+    helper.append_op(type="log_loss",
+                     inputs={"Predicted": [input], "Labels": [label]},
+                     outputs={"Loss": [out]},
+                     attrs={"epsilon": float(epsilon)})
     return out

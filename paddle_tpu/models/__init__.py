@@ -7,6 +7,7 @@ from paddle_tpu.models.lenet import lenet5
 from paddle_tpu.models.vgg import vgg16
 from paddle_tpu.models.alexnet import alexnet
 from paddle_tpu.models.googlenet import googlenet
+from paddle_tpu.models.wide_deep import wide_deep
 from paddle_tpu.models.lstm_text import lstm_text_classifier
 from paddle_tpu.models.transformer import (
     transformer_lm,

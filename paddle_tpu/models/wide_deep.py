@@ -1,0 +1,46 @@
+"""Wide & Deep (the sparse-embedding acceptance model of the build plan
+— SURVEY §7.11 "Wide&Deep sparse"; exercises the reference's
+sparse_remote_update-era capability: huge embedding tables with
+SelectedRows gradients, reference doc
+doc/design/cluster_train/large_model_dist_train.md).
+
+Wide side: one big sparse-gradient embedding over hashed cross
+features acting as a learned linear map; deep side: per-field
+embeddings -> MLP.  Both halves keep every lookup a static-shape
+gather (MXU/sparsecore-friendly) and the table gradients flow as
+`SparseGrad` rows so only touched rows are updated/shipped.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu import layers
+from paddle_tpu.param_attr import ParamAttr
+
+__all__ = ["wide_deep"]
+
+
+def wide_deep(wide_ids, deep_ids, wide_vocab: int, deep_vocab: int,
+              num_fields: int, emb_dim: int = 16, hidden=(64, 32),
+              is_sparse: bool = True):
+    """wide_ids: (B, W, 1) int64 hashed cross-feature ids;
+    deep_ids: (B, F, 1) int64, one id per field (F = num_fields).
+    Returns the CTR logit's sigmoid probability (B, 1)."""
+    # wide: embedding with output dim 1 == sparse linear weights; sum
+    # over the W lookups gives w · x for the multi-hot features
+    wide_w = layers.embedding(
+        wide_ids, size=[wide_vocab, 1], is_sparse=is_sparse,
+        param_attr=ParamAttr(name="wide_w"))
+    wide_part = layers.reduce_sum(wide_w, dim=1)          # (B, 1)
+
+    deep_emb = layers.embedding(
+        deep_ids, size=[deep_vocab, emb_dim], is_sparse=is_sparse,
+        param_attr=ParamAttr(name="deep_emb"))
+    x = layers.reshape(deep_emb, [-1, num_fields * emb_dim])
+    for i, h in enumerate(hidden):
+        x = layers.fc(input=x, size=h, act="relu",
+                      param_attr=ParamAttr(name=f"deep_fc{i}.w"))
+    deep_part = layers.fc(input=x, size=1,
+                          param_attr=ParamAttr(name="deep_out.w"))
+
+    logit = layers.elementwise_add(wide_part, deep_part)
+    return layers.sigmoid(logit)

@@ -268,3 +268,57 @@ def test_googlenet_forward_and_train_step(rng):
     ys = rng.randint(0, 10, (2, 1)).astype("int64")
     (l,) = exe.run(feed={"img": xs, "label": ys}, fetch_list=[loss])
     assert np.isfinite(float(l))
+
+
+def test_wide_deep_sparse_ctr(rng):
+    """Wide&Deep CTR model with sparse-gradient embeddings learns a
+    synthetic click rule (SURVEY §7.11 acceptance: Wide&Deep sparse;
+    reference capability: large_model_dist_train sparse embeddings)."""
+    from paddle_tpu.models import wide_deep
+    from paddle_tpu.sparse import SparseGrad
+
+    Wv, Dv, F, W = 500, 200, 4, 6
+    wide = fluid.layers.data(name="wide", shape=[W, 1], dtype="int64")
+    deep = fluid.layers.data(name="deep", shape=[F, 1], dtype="int64")
+    label = fluid.layers.data(name="label", shape=[1], dtype="float32")
+    prob = wide_deep(wide, deep, wide_vocab=Wv, deep_vocab=Dv, num_fields=F)
+    loss = fluid.layers.mean(fluid.layers.log_loss(prob, label))
+    # the embedding gradients must travel the SelectedRows path
+    pgs = fluid.backward.append_backward(loss)
+    gmap = {p.name: g for p, g in pgs}
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    # click iff any wide id < 25 (memorization) or field-0 id < 20
+    # (generalization via deep side)
+    def batch(n=64):
+        w = rng.randint(25, Wv, (n, W, 1))
+        d = rng.randint(20, Dv, (n, F, 1))
+        y = np.zeros((n, 1), np.float32)
+        hot = rng.rand(n) < 0.5
+        for i in range(n):
+            if hot[i]:
+                if rng.rand() < 0.5:
+                    w[i, 0, 0] = rng.randint(0, 25)
+                else:
+                    d[i, 0, 0] = rng.randint(0, 20)
+                y[i] = 1.0
+        return w.astype(np.int64), d.astype(np.int64), y
+
+    # check one fetch is sparse
+    wname = "wide_w"
+    wgrad = gmap[wname]
+    w, d, y = batch()
+    (g,) = exe.run(feed={"wide": w, "deep": d, "label": y},
+                   fetch_list=[wgrad])
+    assert isinstance(g, SparseGrad)
+
+    first = last = None
+    for _ in range(150):
+        w, d, y = batch()
+        (l,) = exe.run(feed={"wide": w, "deep": d, "label": y},
+                       fetch_list=[loss])
+        first = first if first is not None else float(l)
+        last = float(l)
+    assert last < 0.4 * first, (first, last)
