@@ -1,0 +1,7 @@
+"""v2 master client namespace (reference: python/paddle/v2/master —
+the ctypes wrapper over libpaddle_master.so; here over the native
+master service via paddle_tpu.distributed)."""
+
+from paddle_tpu.v2.master.client import client
+
+__all__ = ["client"]

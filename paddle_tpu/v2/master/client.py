@@ -1,0 +1,54 @@
+"""v2 master client (reference: python/paddle/v2/master/client.py:10 —
+ctypes over go/master/c/client.go; same surface over the C++ master
+service)."""
+
+from __future__ import annotations
+
+
+class client:
+    """API-compatible with the reference's paddle.v2.master.client:
+    set_dataset(paths-or-records), next_record(), paddle_start_get_records
+    semantics via the task queue."""
+
+    def __init__(self, etcd_endpoints=None, timeout_sec=30, buf_size=0,
+                 addr=None):
+        from paddle_tpu.distributed import MasterClient
+
+        if addr is None:
+            # the reference discovered the master through etcd; here the
+            # launcher exports PADDLE_MASTER (scripts/cluster_launch.py),
+            # or a coord store holds it under /master/addr
+            import os
+
+            addr = os.environ.get("PADDLE_MASTER")
+            if addr is None and os.environ.get("PADDLE_COORD"):
+                from paddle_tpu.distributed import CoordClient
+
+                with CoordClient(os.environ["PADDLE_COORD"]) as cc:
+                    addr = cc.master_addr(wait_timeout_ms=int(timeout_sec * 1000))
+        if addr is None:
+            raise RuntimeError(
+                "no master address: set PADDLE_MASTER/PADDLE_COORD or pass addr=")
+        self._c = MasterClient(addr, timeout=timeout_sec)
+
+    def set_dataset(self, paths):
+        self._c.set_dataset(list(paths))
+
+    def next_record(self):
+        """-> (record_bytes, 0) or (None, error) like the reference
+        (client.py next_record returning (r, err))."""
+        got = self._c.get_task()
+        if got is None:
+            return None, -1
+        task_id, payload = got
+        self._c.task_finished(task_id)
+        return payload, 0
+
+    def request_save_model(self, trainer_id, block_ms):
+        return 1  # single-trainer saves always win (reference semantics)
+
+    def paddle_start_get_records(self, pass_id=0):
+        pass
+
+    def close(self):
+        self._c.close()

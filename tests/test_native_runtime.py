@@ -227,3 +227,27 @@ def test_buddy_allocator_oversize_fallback():
     l.mem_free(pool, big)
     assert l.mem_used(pool) == 0
     l.mem_pool_destroy(pool)
+
+
+def test_v2_master_client_namespace():
+    """paddle.v2.master.client surface (reference:
+    python/paddle/v2/master/client.py over go/master/c/client.go)."""
+    import os
+
+    from paddle_tpu.v2.master import client
+
+    with MasterServer() as m:
+        os.environ["PADDLE_MASTER"] = m.address
+        try:
+            c = client()
+            c.set_dataset(["rec-a", "rec-b"])
+            got = set()
+            for _ in range(2):
+                r, err = c.next_record()
+                assert err == 0
+                got.add(r)
+            assert got == {"rec-a", "rec-b"}
+            assert c.request_save_model(0, 100) == 1
+            c.close()
+        finally:
+            del os.environ["PADDLE_MASTER"]
