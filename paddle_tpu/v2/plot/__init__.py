@@ -1,0 +1,7 @@
+"""Training-curve plotting (reference: python/paddle/v2/plot — Ploter
+collecting per-step costs and rendering via matplotlib when available,
+falling back to appending values)."""
+
+from paddle_tpu.v2.plot.plot import Ploter
+
+__all__ = ["Ploter"]

@@ -135,3 +135,27 @@ def test_orbax_checkpoint_roundtrip(tmp_path):
     # moments restored too: next update equals a never-diverged replica
     moment_names = [n for n in restored if "moment" in n]
     assert moment_names
+
+
+def test_net_drawer_dot_output():
+    from paddle_tpu import net_drawer
+
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=2, act="softmax")
+    dot = net_drawer.draw_graph()
+    assert dot.startswith("digraph") and dot.rstrip().endswith("}")
+    assert "mul" in dot and "softmax" in dot and '"x"' in dot
+    # parameter nodes shaded
+    assert "lightgrey" in dot
+
+
+def test_v2_ploter():
+    from paddle_tpu.v2.plot import Ploter
+
+    p = Ploter("train", "test")
+    p.append("train", 0, 1.0)
+    p.append("train", 1, 0.5)
+    p.append("test", 0, 0.9)
+    assert p["train"].value == [1.0, 0.5]
+    p.reset()
+    assert p["train"].value == []
