@@ -1,0 +1,57 @@
+"""Default-scope helpers (reference:
+python/paddle/v2/fluid/default_scope_funcs.py — a thread-local scope
+stack with enter/leave and var lookup in the innermost scope)."""
+
+from __future__ import annotations
+
+import threading
+
+from paddle_tpu.executor import Scope, global_scope
+
+__all__ = ["get_cur_scope", "enter_local_scope", "leave_local_scope",
+           "var", "find_var", "scoped_function"]
+
+_local = threading.local()
+
+
+def _stack():
+    if not hasattr(_local, "stack"):
+        _local.stack = [global_scope()]
+    return _local.stack
+
+
+def get_cur_scope() -> Scope:
+    return _stack()[-1]
+
+
+def enter_local_scope() -> Scope:
+    s = get_cur_scope().new_scope()
+    _stack().append(s)
+    return s
+
+
+def leave_local_scope():
+    stack = _stack()
+    if len(stack) > 1:
+        stack.pop()
+
+
+def var(name: str):
+    return get_cur_scope().var(name)
+
+
+def find_var(name: str):
+    return get_cur_scope().find_var(name)
+
+
+def scoped_function(fn):
+    """Run ``fn`` inside a fresh local scope (decorator or direct)."""
+    def wrapper(*a, **k):
+        enter_local_scope()
+        try:
+            return fn(*a, **k)
+        finally:
+            leave_local_scope()
+
+    wrapper.__name__ = getattr(fn, "__name__", "scoped")
+    return wrapper
