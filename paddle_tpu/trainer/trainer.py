@@ -187,6 +187,12 @@ def main(argv=None):
     p.add_argument("--trainer_count", type=int, default=1,
                    help="data-parallel shards (devices on the mesh)")
     a = p.parse_args(argv)
+    if a.trainer_count > 1:
+        # data-parallel mesh for the run (MultiGradientMachine's
+        # trainer_count, realized as SPMD; see v2.init)
+        from paddle_tpu import v2 as v2pkg
+
+        v2pkg.init(trainer_count=a.trainer_count)
     t0 = time.time()
     _, costs = train_from_config(a.config, num_passes=a.num_passes,
                                  save_dir=a.save_dir,

@@ -34,10 +34,42 @@ _initialized = False
 
 def init(use_gpu: bool = False, trainer_count: int = 1, **kwargs):
     """Process init (reference: paddle.v2.init -> swig initPaddle).
-    Accepted for compatibility; device selection happens via
-    jax/Executor places.  ``use_gpu`` maps to the accelerator place."""
-    global _initialized
+    ``trainer_count`` keeps its reference meaning — intra-process data
+    parallelism (MultiGradientMachine, trainer_count flag,
+    utils/Flags.cpp:37) — realized as an SPMD mesh over that many
+    devices instead of trainer threads."""
+    global _initialized, _trainer_count
     _initialized = True
+    _trainer_count = int(trainer_count)
+    from paddle_tpu.flags import FLAGS
+
+    FLAGS.set("trainer_count", int(trainer_count))
+    FLAGS.set("use_gpu", bool(use_gpu))
+
+
+_trainer_count = 1
+
+
+def _dp_strategy():
+    """DataParallelStrategy over trainer_count devices, or None for
+    single-device training (also when fewer devices exist)."""
+    if _trainer_count <= 1:
+        return None
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < _trainer_count:
+        import warnings
+
+        warnings.warn(
+            f"trainer_count={_trainer_count} but only {len(devs)} "
+            f"device(s) visible; training single-device", stacklevel=2)
+        return None
+    from paddle_tpu.parallel.strategy import (DataParallelStrategy,
+                                              make_mesh)
+
+    mesh = make_mesh({"dp": _trainer_count}, devices=devs[:_trainer_count])
+    return DataParallelStrategy(mesh)
 
 
 batch = minibatch.batch

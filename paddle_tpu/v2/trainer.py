@@ -132,7 +132,13 @@ class SGD:
             self._server_cfg = update_equation.server_config()
             self._pserver_addrs = list(pserver_addrs)
         # startup may have grown (lr/accumulators): re-init the new vars
-        exe = Executor(TPUPlace())
+        # trainer_count>1 -> SPMD data parallelism over a dp mesh (the
+        # MultiGradientMachine replacement: one compiled program, batch
+        # sharded, GSPMD-inserted psum instead of thread grad-merge)
+        from paddle_tpu import v2 as _v2pkg
+
+        strategy = _v2pkg._dp_strategy()
+        exe = Executor(TPUPlace(), strategy=strategy)
         with executor_mod.scope_guard(self.parameters.scope):
             exe.run(self.topology.startup_program)
         self._exe = exe

@@ -228,3 +228,42 @@ def test_tp_param_state_is_sharded():
     val = scope.get(qkv_names[0])
     spec = val.sharding.spec
     assert "tp" in str(spec), spec
+
+
+def test_v2_trainer_count_data_parallel():
+    """paddle.init(trainer_count=N) data-parallels the v2 SGD over an
+    N-device dp mesh (the MultiGradientMachine / trainer_count
+    semantics, MultiGradientMachine.h:30; here: SPMD instead of
+    trainer threads) — and matches single-device training numerically."""
+    import numpy as np
+    import paddle_tpu
+    import paddle_tpu.v2 as paddle
+
+    def run(tc):
+        paddle_tpu.framework.reset_default_programs()
+        paddle.init(use_gpu=False, trainer_count=tc)
+        x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(8))
+        y = paddle.layer.data(name="y", type=paddle.data_type.dense_vector(1))
+        pred = paddle.layer.fc(input=x, size=1,
+                               param_attr=paddle.attr.Param(initial_std=0.0))
+        cost = paddle.layer.mse_cost(input=pred, label=y)
+        params = paddle.parameters.create(cost)
+        tr = paddle.trainer.SGD(cost=cost, parameters=params,
+                                update_equation=paddle.optimizer.Momentum(
+                                    momentum=0.9, learning_rate=1e-2))
+        rng = np.random.RandomState(0)
+        data = [(rng.randn(8).tolist(), [float(rng.randn())])
+                for _ in range(64)]
+        costs = []
+        tr.train(paddle.batch(lambda: iter(data), batch_size=16),
+                 num_passes=3,
+                 event_handler=lambda e: costs.append(e.cost) if isinstance(
+                     e, paddle.event.EndIteration) else None)
+        paddle.init(use_gpu=False, trainer_count=1)  # restore
+        return np.asarray(costs)
+
+    single = run(1)
+    dp = run(4)   # 4 of the 8 virtual CPU devices
+    assert dp.shape == single.shape
+    np.testing.assert_allclose(dp, single, rtol=1e-4, atol=1e-5)
+    assert dp[-1] < dp[0]
