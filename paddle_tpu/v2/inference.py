@@ -16,6 +16,18 @@ from paddle_tpu.v2.topology import Topology
 
 class Inference:
     def __init__(self, output_layer, parameters):
+        from paddle_tpu.generation import BeamGen
+
+        self._gen = None
+        if isinstance(output_layer, BeamGen):
+            # generation spec from v1 beam_search: decode instead of a
+            # plain forward (reference: infer on a generating config ran
+            # RecurrentGradientMachine::generateSequence)
+            from paddle_tpu.generation import SequenceGenerator
+
+            self._gen = SequenceGenerator(output_layer, parameters)
+            self.parameters = parameters
+            return
         outputs = output_layer if isinstance(output_layer, (list, tuple)) \
             else [output_layer]
         self.topology = Topology(cost=None, output_layers=list(outputs),
@@ -24,6 +36,12 @@ class Inference:
         self._exe = Executor(TPUPlace())
 
     def infer(self, input, feeding=None, field="value"):
+        if self._gen is not None:
+            # one beam list per input row: [(score, [ids...]), ...]
+            beams = [self._gen.generate(row) for row in input]
+            if field == "id":
+                return [b[0][1] if b else [] for b in beams]
+            return beams
         from paddle_tpu.v2.trainer import V2DataFeeder
 
         feeder = V2DataFeeder(self.topology.feed_types, feeding)

@@ -303,3 +303,49 @@ def test_attention_decoder_in_recurrent_group():
                  ev, paddle.event.EndIteration) else None)
     assert np.mean(costs[-3:]) < 0.4 * np.mean(costs[:3]), (
         costs[:3], costs[-3:])
+
+
+def test_v2_infer_with_beam_gen():
+    """paddle.v2 infer(output_layer=beam_search(...)) decodes (the
+    reference's generation entry point: inference.py over a generating
+    RecurrentGradientMachine)."""
+    from paddle_tpu.trainer_config_helpers import (GeneratedInput,
+                                                   StaticInput, beam_search,
+                                                   memory)
+    import paddle_tpu.v2.layer as _v2l
+
+    V, E, H = 6, 8, 10
+    BOS, EOS = 0, 1
+
+    def step(word_emb, c):
+        mem = memory(name="d", size=H)
+        h = _v2l.fc(input=[word_emb, mem, c], size=H, act="tanh", name="d",
+                    param_attr=[paddle.attr.Param(name="gi_w1"),
+                                paddle.attr.Param(name="gi_w2"),
+                                paddle.attr.Param(name="gi_w3")],
+                    bias_attr=False)
+        return _v2l.fc(input=h, size=V, act="softmax",
+                       param_attr=paddle.attr.Param(name="gi_wo"),
+                       bias_attr=False)
+
+    ctxv = paddle.layer.data(name="c", type=paddle.data_type.dense_vector(H))
+    bg = beam_search(step=step,
+                     input=[GeneratedInput(size=V, embedding_name="gi_emb",
+                                           embedding_size=E),
+                            StaticInput(ctxv, size=H)],
+                     bos_id=BOS, eos_id=EOS, beam_size=2, max_length=4)
+    # random params: just verify the plumbing produces id sequences
+    from paddle_tpu.v2.topology import Topology  # noqa: F401
+
+    class _P:
+        pass
+
+    from paddle_tpu.executor import Scope
+
+    params = _P()
+    params.scope = Scope()
+    ids = paddle.infer(output_layer=bg, parameters=params,
+                       input=[[np.zeros(H, np.float32).tolist()]],
+                       field="id")
+    assert len(ids) == 1
+    assert all(0 <= t < V for t in ids[0])
