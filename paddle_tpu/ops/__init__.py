@@ -23,3 +23,4 @@ from paddle_tpu.ops import attention_ops  # noqa: F401
 from paddle_tpu.ops import pipeline_ops  # noqa: F401
 from paddle_tpu.ops import ctc_ops  # noqa: F401
 from paddle_tpu.ops import detection_ops  # noqa: F401
+from paddle_tpu.ops import aliases  # noqa: F401  (must be last)

@@ -1,0 +1,24 @@
+"""cudnn-named op aliases (reference: operators/conv_cudnn_op.cc etc. —
+separate registrations of the same math bound to cuDNN kernels; on XLA
+there is exactly one lowering, so aliases share it).  Imported LAST so
+every target exists."""
+
+from __future__ import annotations
+
+from paddle_tpu.registry import OpRegistry, register_op
+
+
+def _alias_op(alias: str, target: str, inputs, outputs=("Out",)):
+    info = OpRegistry.get(target)
+    register_op(alias, inputs=inputs, outputs=outputs,
+                diff_inputs=info.diff_inputs)(info.lower)
+
+
+_alias_op("conv2d_cudnn", "conv2d", ("Input", "Filter"), ("Output",))
+_alias_op("conv3d_cudnn", "conv3d", ("Input", "Filter"), ("Output",))
+_alias_op("conv2d_transpose_cudnn", "conv2d_transpose",
+          ("Input", "Filter"), ("Output",))
+_alias_op("conv3d_transpose_cudnn", "conv3d_transpose",
+          ("Input", "Filter"), ("Output",))
+_alias_op("pool2d_cudnn", "pool2d", ("X",))
+_alias_op("pool3d_cudnn", "pool3d", ("X",))

@@ -393,3 +393,35 @@ def _sequence_slice(ctx):
     new_data = jnp.where(keep[order][:, None], x.data[order], 0.0)
     new_off = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(length)])
     ctx.set_output("Out", LoDArray(new_data, (new_off,)))
+
+
+@register_op("max_pool3d_with_index", inputs=("X",), outputs=("Out", "Mask"))
+def _max_pool3d_with_index(ctx):
+    """3-D max pool emitting global flat D*H*W argmax indices
+    (reference: operators/pool_with_index_op.cc, 3-D registration)."""
+    x = unwrap(ctx.input("X"))
+    ks = tuple(ctx.attr("ksize", (2, 2, 2)))
+    st = tuple(ctx.attr("strides", ks))
+    pd = tuple(ctx.attr("paddings", (0, 0, 0)))
+    if ctx.attr("global_pooling", False):
+        ks, st, pd = x.shape[2:5], (1, 1, 1), (0, 0, 0)
+    B, C, D, H, W = x.shape
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=ks, window_strides=st,
+        padding=[(p, p) for p in pd],
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    OD, OH, OW = patches.shape[2:5]
+    patches = patches.reshape(B, C, ks[0] * ks[1] * ks[2], OD, OH, OW)
+    out = jnp.max(patches, axis=2)
+    within = jnp.argmax(patches, axis=2).astype(jnp.int32)
+    od = jnp.arange(OD)[:, None, None] * st[0] - pd[0]
+    oh = jnp.arange(OH)[None, :, None] * st[1] - pd[1]
+    ow = jnp.arange(OW)[None, None, :] * st[2] - pd[2]
+    wd = within // (ks[1] * ks[2])
+    wh = (within // ks[2]) % ks[1]
+    ww = within % ks[2]
+    gd = jnp.clip(od[None, None] + wd, 0, D - 1)
+    gh = jnp.clip(oh[None, None] + wh, 0, H - 1)
+    gw = jnp.clip(ow[None, None] + ww, 0, W - 1)
+    ctx.set_output("Out", out)
+    ctx.set_output("Mask", (gd * H + gh) * W + gw)

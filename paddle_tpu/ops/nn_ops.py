@@ -256,3 +256,23 @@ def _crop(ctx):
         shape = ctx.attr("shape")
     sl = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
     ctx.set_output("Out", x[sl])
+
+
+@register_op("conv3d_transpose", inputs=("Input", "Filter"),
+             outputs=("Output",))
+def _conv3d_transpose(ctx):
+    """3-D transposed conv (reference: operators/conv_transpose_op.cc
+    3-D registration).  Filter layout (I, O, D, H, W)."""
+    x = unwrap(ctx.input("Input"))
+    w = unwrap(ctx.input("Filter"))
+    strides = tuple(ctx.attr("strides", (1, 1, 1)))
+    pads = tuple(ctx.attr("paddings", (0, 0, 0)))
+    dilations = tuple(ctx.attr("dilations", (1, 1, 1)))
+    out = lax.conv_transpose(
+        x, w, strides=strides,
+        padding=[(p, p) for p in pads],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCDHW", "IODHW", "NCDHW"),
+        transpose_kernel=True,
+    ).astype(x.dtype)
+    ctx.set_output("Output", out)

@@ -246,3 +246,69 @@ def test_global_norm_clip(rng):
     w1 = np.asarray(scope.get(pname))
     # update magnitude bounded by lr * clip_norm
     assert np.linalg.norm(w1 - w0) <= 1e-3 + 1e-6
+
+
+def test_max_pool3d_with_index_matches_numpy():
+    """3-D pool-with-index (reference: pool_with_index_op.cc 3-D)."""
+    import paddle_tpu as fluid
+
+    fluid.framework.reset_default_programs()
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 2, 4, 4, 4).astype("float32")
+    xi = fluid.layers.data(name="x", shape=[2, 4, 4, 4], dtype="float32")
+    b = fluid.default_main_program().global_block()
+    out = b.create_var(name="o", shape=(1, 2, 2, 2, 2), dtype="float32")
+    mask = b.create_var(name="m", shape=(1, 2, 2, 2, 2), dtype="int32")
+    b.append_op(type="max_pool3d_with_index", inputs={"X": [xi]},
+                outputs={"Out": [out], "Mask": [mask]},
+                attrs={"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                       "paddings": [0, 0, 0]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    o, m = exe.run(feed={"x": x}, fetch_list=[out, mask])
+    o, m = np.asarray(o), np.asarray(m)
+    for c in range(2):
+        for d in range(2):
+            for i in range(2):
+                for j in range(2):
+                    blk = x[0, c, 2*d:2*d+2, 2*i:2*i+2, 2*j:2*j+2]
+                    assert abs(o[0, c, d, i, j] - blk.max()) < 1e-6
+                    flat = x[0, c].ravel()
+                    assert abs(flat[m[0, c, d, i, j]] - blk.max()) < 1e-6
+
+
+def test_conv3d_transpose_inverts_stride():
+    """conv3d_transpose upsamples like grad-of-conv3d (reference:
+    conv_transpose_op.cc 3-D): identity 1-voxel kernel with stride 2
+    spreads inputs onto the even lattice."""
+    import paddle_tpu as fluid
+
+    fluid.framework.reset_default_programs()
+    x = np.arange(8, dtype=np.float32).reshape(1, 1, 2, 2, 2)
+    w = np.ones((1, 1, 1, 1, 1), np.float32)
+    xi = fluid.layers.data(name="x", shape=[1, 2, 2, 2], dtype="float32")
+    wi = fluid.layers.data(name="w", shape=[1, 1, 1, 1, 1],
+                           dtype="float32", append_batch_size=False)
+    b = fluid.default_main_program().global_block()
+    out = b.create_var(name="o3", shape=(1, 1, 3, 3, 3), dtype="float32")
+    b.append_op(type="conv3d_transpose",
+                inputs={"Input": [xi], "Filter": [wi]},
+                outputs={"Output": [out]},
+                attrs={"strides": [2, 2, 2], "paddings": [0, 0, 0],
+                       "dilations": [1, 1, 1]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    (o,) = exe.run(feed={"x": x, "w": w}, fetch_list=[out])
+    o = np.asarray(o)
+    want = np.zeros((3, 3, 3), np.float32)
+    for d in range(2):
+        for i in range(2):
+            for j in range(2):
+                want[2*d, 2*i, 2*j] = x[0, 0, d, i, j]
+    np.testing.assert_allclose(o[0, 0], want, atol=1e-6)
+
+
+def test_cudnn_alias_ops_registered():
+    from paddle_tpu.registry import OpRegistry
+
+    for name in ["conv2d_cudnn", "conv3d_cudnn", "conv2d_transpose_cudnn",
+                 "conv3d_transpose_cudnn", "pool2d_cudnn", "pool3d_cudnn"]:
+        assert OpRegistry.has(name), name
