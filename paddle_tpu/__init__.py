@@ -48,5 +48,12 @@ from paddle_tpu.data_feeder import DataFeeder
 from paddle_tpu.param_attr import ParamAttr
 from paddle_tpu.lod import LoDArray, create_lod_array
 from paddle_tpu import parallel
+from paddle_tpu import backward
+from paddle_tpu import clip
+from paddle_tpu import lr_scheduler
+from paddle_tpu import net_drawer
+from paddle_tpu import flags
+from paddle_tpu import stat
+from paddle_tpu import errors
 
 __version__ = "0.1.0"
