@@ -220,3 +220,45 @@ def test_factorization_machine_learns_interactions():
         first = first if first is not None else float(l)
         last = float(l)
     assert last < 0.15 * first, (first, last)
+
+
+class TestHSigmoidGrad:
+    """Numeric gradient check for hierarchical_sigmoid (OpTest style,
+    the reference's auto_gradient_check backbone)."""
+
+    def test_grad(self):
+        from tests.op_test import OpTest
+
+        rng = np.random.RandomState(3)
+
+        class T(OpTest):
+            op_type = "hierarchical_sigmoid"
+
+        t = T()
+        B, D, V = 3, 4, 8
+        x = rng.randn(B, D).astype("float32")
+        w = (rng.randn(V - 1, D) * 0.5).astype("float32")
+        b = (rng.randn(V - 1) * 0.1).astype("float32")
+        lab = rng.randint(0, V, (B, 1)).astype("int64")
+        t.check_grad(
+            {"X": [("x", x)], "W": [("w", w)], "Bias": [("b", b)],
+             "Label": [("lab", lab)]},
+            {}, ["Cost"], wrt=["x", "w", "b"], loss_slot="Cost",
+            atol=5e-2, rtol=5e-2)
+
+
+class TestFactorizationMachineGrad:
+    def test_grad(self):
+        from tests.op_test import OpTest
+
+        rng = np.random.RandomState(4)
+
+        class T(OpTest):
+            op_type = "factorization_machine"
+
+        t = T()
+        x = rng.randn(3, 5).astype("float32")
+        w = (rng.randn(5, 2) * 0.5).astype("float32")
+        t.check_grad({"X": [("x", x)], "W": [("w", w)]},
+                     {}, ["Out"], wrt=["x", "w"], loss_slot="Out",
+                     atol=5e-2, rtol=5e-2)

@@ -210,3 +210,31 @@ def test_ctc_empty_label():
                      torch.tensor(label_lens), blank=0, reduction="none")
     np.testing.assert_allclose(np.asarray(ours).ravel(), ref.numpy(),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_ssd_loss_numeric_grad():
+    """Central-difference gradient check for ssd_loss wrt loc and conf
+    (MultiBoxLossLayer's grad entry in test_LayerGrad.cpp)."""
+    from tests.op_test import OpTest
+
+    rng = np.random.RandomState(5)
+
+    class T(OpTest):
+        op_type = "ssd_loss"
+
+    t = T()
+    B, M, C, G = 2, 4, 3, 1
+    prior = np.array([[0, 0, .5, .5], [.5, 0, 1, .5],
+                      [0, .5, .5, 1], [.5, .5, 1, 1]], np.float32)
+    pvar = np.full((M, 4), 0.1, np.float32)
+    loc = (rng.randn(B, M, 4) * 0.1).astype("float32")
+    conf = rng.randn(B, M, C).astype("float32")
+    gt = np.array([[[0, 0, .5, .5]], [[.5, .5, 1, 1]]], np.float32)
+    gtl = np.array([[1], [2]], np.int64)
+    t.check_grad(
+        {"Loc": [("loc", loc)], "Conf": [("conf", conf)],
+         "PriorBox": [("pb", prior)], "PriorBoxVar": [("pv", pvar)],
+         "GtBox": [("gt", gt)], "GtLabel": [("gtl", gtl)]},
+        {"overlap_threshold": 0.5, "neg_pos_ratio": 3.0},
+        ["Loss"], wrt=["loc", "conf"], loss_slot="Loss",
+        atol=5e-2, rtol=5e-2)
