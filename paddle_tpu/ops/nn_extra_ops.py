@@ -425,3 +425,23 @@ def _max_pool3d_with_index(ctx):
     gw = jnp.clip(ow[None, None] + ww, 0, W - 1)
     ctx.set_output("Out", out)
     ctx.set_output("Mask", (gd * H + gh) * W + gw)
+
+
+@register_op("block_expand", inputs=("X",))
+def _block_expand(ctx):
+    """im2col to sequence steps (reference: gserver BlockExpandLayer /
+    function/BlockExpandOp.cpp): (B, C, H, W) -> (B, S, C*bh*bw) where
+    S = output positions, each step one block."""
+    x = unwrap(ctx.input("X"))
+    bh, bw = int(ctx.attr("block_y")), int(ctx.attr("block_x"))
+    sh = int(ctx.attr("stride_y", bh))
+    sw = int(ctx.attr("stride_x", bw))
+    ph = int(ctx.attr("padding_y", 0))
+    pw = int(ctx.attr("padding_x", 0))
+    patches = lax.conv_general_dilated_patches(
+        x, filter_shape=(bh, bw), window_strides=(sh, sw),
+        padding=[(ph, ph), (pw, pw)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    B, CKK, OH, OW = patches.shape
+    ctx.set_output("Out",
+                   jnp.moveaxis(patches.reshape(B, CKK, OH * OW), 1, 2))

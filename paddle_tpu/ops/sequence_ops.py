@@ -320,3 +320,28 @@ def _padded_sequence_softmax(ctx):
     out = jax.nn.softmax(s.astype(jnp.float32), axis=1).astype(x.dtype)
     out = jnp.where(valid, out, 0.0)
     ctx.set_output("Out", out[..., None] if squeeze else out)
+
+
+@register_op("padded_sequence_slice",
+             inputs=("X", "Length", "Offset", "SliceLen"),
+             outputs=("Out", "OutLength"), diff_inputs=("X",))
+def _padded_sequence_slice(ctx):
+    """Per-row window [offset, offset+slice_len) of a padded (B, T, ...)
+    batch, re-packed to the front (the padded analog of
+    operators/sequence_slice_op.cc; v1 SequenceSliceLayer/
+    SubSequenceLayer semantics)."""
+    x = unwrap(ctx.input("X"))
+    lens = unwrap(ctx.input("Length")).reshape(-1).astype(jnp.int32)
+    off = unwrap(ctx.input("Offset")).reshape(-1).astype(jnp.int32)
+    sl = unwrap(ctx.input("SliceLen")).reshape(-1).astype(jnp.int32)
+    T = x.shape[1]
+    idx = jnp.arange(T, dtype=jnp.int32)[None, :] + off[:, None]
+    gathered = jnp.take_along_axis(
+        x, jnp.clip(idx, 0, T - 1).reshape(idx.shape + (1,) * (x.ndim - 2)),
+        axis=1) if x.ndim > 2 else jnp.take_along_axis(
+        x, jnp.clip(idx, 0, T - 1), axis=1)
+    new_len = jnp.clip(jnp.minimum(sl, lens - off), 0, T)
+    valid = jnp.arange(T)[None, :] < new_len[:, None]
+    vmask = valid.reshape(valid.shape + (1,) * (x.ndim - 2))
+    ctx.set_output("Out", jnp.where(vmask, gathered, 0))
+    ctx.set_output("OutLength", new_len)

@@ -222,3 +222,12 @@ def _one_hot(ctx):
         x = x[..., 0]
     depth = ctx.attr("depth")
     ctx.set_output("Out", jax.nn.one_hot(x, depth, dtype=jnp.float32))
+
+
+@register_op("reverse", inputs=("X",))
+def _reverse(ctx):
+    """Flip along `axis` (reference capability: RotateLayer's flip half;
+    fluid gained a reverse op in later versions)."""
+    x = unwrap(ctx.input("X"))
+    axis = ctx.attr("axis", 0)
+    ctx.set_output("Out", rewrap(ctx.input("X"), jnp.flip(x, axis=axis)))

@@ -13,7 +13,8 @@ so a parsed v1 config trains on the identical TPU Program path.
 from paddle_tpu.trainer_config_helpers.activations import *  # noqa: F401,F403
 from paddle_tpu.trainer_config_helpers.attrs import *  # noqa: F401,F403
 from paddle_tpu.trainer_config_helpers.poolings import *  # noqa: F401,F403
-from paddle_tpu.trainer_config_helpers.layers import *  # noqa: F401,F403
+from paddle_tpu.trainer_config_helpers.layers import *
+from paddle_tpu.trainer_config_helpers.layers_extra import *  # noqa: F401,F403  # noqa: F401,F403
 from paddle_tpu.trainer_config_helpers.networks import *  # noqa: F401,F403
 from paddle_tpu.trainer_config_helpers.optimizers import *  # noqa: F401,F403
 from paddle_tpu.trainer_config_helpers.data_sources import *  # noqa: F401,F403

@@ -1,0 +1,239 @@
+"""Smoke + numeric tests for the wave-2 v1 layer constructors
+(reference: the long tail of trainer_config_helpers/layers.py __all__,
+exercised the way test_LayerGrad.cpp swept every registered layer)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.v2 as paddle
+from paddle_tpu.v2.inference import Inference
+from paddle_tpu import trainer_config_helpers as tch
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    fluid.framework.reset_default_programs()
+    paddle.init(use_gpu=False, trainer_count=1)
+    yield
+
+
+def _infer(out_layer, rows, feeding=None):
+    params = paddle.parameters.create(out_layer)
+    return np.asarray(Inference(out_layer, params).infer(rows,
+                                                         feeding=feeding))
+
+
+def test_elementwise_norm_layers():
+    rng = np.random.RandomState(0)
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    xs = np.abs(rng.randn(2, 4)).astype(np.float32) + 0.1
+
+    out = _infer(tch.sum_to_one_norm_layer(x), [[r.tolist()] for r in xs])
+    np.testing.assert_allclose(out, xs / xs.sum(1, keepdims=True), rtol=1e-5)
+
+    fluid.framework.reset_default_programs()
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    out = _infer(tch.row_l2_norm_layer(x), [[r.tolist()] for r in xs])
+    np.testing.assert_allclose(
+        out, xs / np.linalg.norm(xs, axis=1, keepdims=True), rtol=1e-5)
+
+
+def test_pairwise_layers():
+    rng = np.random.RandomState(1)
+    a = paddle.layer.data(name="a", type=paddle.data_type.dense_vector(3))
+    b = paddle.layer.data(name="b", type=paddle.data_type.dense_vector(3))
+    av = rng.randn(2, 3).astype(np.float32)
+    bv = rng.randn(2, 3).astype(np.float32)
+    rows = [[av[i].tolist(), bv[i].tolist()] for i in range(2)]
+
+    got = _infer(tch.dot_prod_layer(a, b), rows)
+    np.testing.assert_allclose(got.ravel(), (av * bv).sum(1), rtol=1e-5)
+
+    fluid.framework.reset_default_programs()
+    a = paddle.layer.data(name="a", type=paddle.data_type.dense_vector(3))
+    b = paddle.layer.data(name="b", type=paddle.data_type.dense_vector(3))
+    got = _infer(tch.l2_distance_layer(a, b), rows)
+    np.testing.assert_allclose(got.ravel(),
+                               np.linalg.norm(av - bv, axis=1), rtol=1e-5)
+
+    fluid.framework.reset_default_programs()
+    a = paddle.layer.data(name="a", type=paddle.data_type.dense_vector(3))
+    b = paddle.layer.data(name="b", type=paddle.data_type.dense_vector(3))
+    got = _infer(tch.out_prod_layer(a, b), rows)
+    want = np.einsum("bi,bj->bij", av, bv).reshape(2, 9)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_linear_comb_layer():
+    rng = np.random.RandomState(2)
+    K, D = 3, 4
+    w = paddle.layer.data(name="w", type=paddle.data_type.dense_vector(K))
+    v = paddle.layer.data(name="v", type=paddle.data_type.dense_vector(K * D))
+    wv = rng.randn(2, K).astype(np.float32)
+    vv = rng.randn(2, K * D).astype(np.float32)
+    got = _infer(tch.linear_comb_layer(w, v, size=D),
+                 [[wv[i].tolist(), vv[i].tolist()] for i in range(2)])
+    want = np.einsum("bk,bkd->bd", wv, vv.reshape(2, K, D))
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_rotate_and_switch_order():
+    h = w = 3
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(h * w))
+    img = np.arange(9, dtype=np.float32)
+    got = _infer(tch.rotate_layer(x, height=h, width=w), [[img.tolist()]])
+    want = np.rot90(img.reshape(3, 3)).reshape(-1)
+    np.testing.assert_allclose(got.ravel(), want)
+
+
+def test_maxout_gated_scale_shift_train_path():
+    """A few wrappers composed into one trainable net (smoke: builds,
+    runs forward, finite loss)."""
+    rng = np.random.RandomState(3)
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(8))
+    y = paddle.layer.data(name="y", type=paddle.data_type.dense_vector(1))
+    g = tch.gated_unit_layer(x, size=6)
+    ss = tch.scale_shift_layer(g)
+    pred = paddle.layer.fc(input=ss, size=1)
+    cost = paddle.layer.mse_cost(input=pred, label=y)
+    params = paddle.parameters.create(cost)
+    tr = paddle.trainer.SGD(cost=cost, parameters=params,
+                            update_equation=paddle.optimizer.Adam(
+                                learning_rate=0.01))
+    costs = []
+    data = [(rng.randn(8).tolist(), [float(rng.randn())]) for _ in range(32)]
+    tr.train(paddle.batch(lambda: iter(data), batch_size=8), num_passes=2,
+             event_handler=lambda e: costs.append(e.cost) if isinstance(
+                 e, paddle.event.EndIteration) else None)
+    assert all(np.isfinite(c) for c in costs)
+
+
+def test_tensor_layer_bilinear():
+    rng = np.random.RandomState(4)
+    a = paddle.layer.data(name="a", type=paddle.data_type.dense_vector(3))
+    b = paddle.layer.data(name="b", type=paddle.data_type.dense_vector(4))
+    out_l = tch.tensor_layer(a, b, size=2, bias_attr=False)
+    params = paddle.parameters.create(out_l)
+    av = rng.randn(1, 3).astype(np.float32)
+    bv = rng.randn(1, 4).astype(np.float32)
+    got = np.asarray(Inference(out_l, params).infer(
+        [[av[0].tolist(), bv[0].tolist()]]))
+    wname = list(params.keys())[0]
+    W = params.get(wname)  # (2, 3, 4)
+    want = np.einsum("bi,kij,bj->bk", av, W, bv)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_smoke_remaining_wrappers():
+    """Everything else at least builds + runs one forward."""
+    rng = np.random.RandomState(5)
+
+    # clip / resize / sampling_id / eos on a dense vector
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(6))
+    clipped = tch.clip_layer(x, min=-0.5, max=0.5)
+    got = _infer(clipped, [[rng.randn(6).tolist()]])
+    assert np.all(got <= 0.5 + 1e-6) and np.all(got >= -0.5 - 1e-6)
+
+    fluid.framework.reset_default_programs()
+    probs = paddle.layer.data(name="p", type=paddle.data_type.dense_vector(5))
+    sid = tch.sampling_id_layer(probs)
+    got = _infer(sid, [[np.full(5, 0.2, np.float32).tolist()]])
+    assert 0 <= int(np.asarray(got).ravel()[0]) < 5
+
+    fluid.framework.reset_default_programs()
+    # kmax scores
+    s = paddle.layer.data(name="s", type=paddle.data_type.dense_vector(5))
+    km = tch.kmax_seq_score_layer(s, beam_size=2)
+    got = _infer(km, [[np.array([5, 1, 4, 2, 3], np.float32).tolist()]])
+    np.testing.assert_allclose(np.sort(got.ravel())[::-1], [5, 4])
+
+    # enums + markers importable
+    assert tch.AggregateLevel.TO_SEQUENCE == "seq"
+    assert tch.ExpandLevel.FROM_NO_SEQUENCE == "non-seq"
+    assert callable(tch.layer_support())
+
+
+def test_spp_layer_shapes():
+    x = paddle.layer.data(name="x",
+                          type=paddle.data_type.dense_vector(1 * 8 * 8))
+
+    # spp over a reshaped 1x8x8 map: build through a conv path instead
+    fluid.framework.reset_default_programs()
+    import paddle_tpu as F
+
+    img = F.layers.data(name="img", shape=[2, 8, 8], dtype="float32")
+    b = F.default_main_program().global_block()
+    # direct fluid composition equivalent of spp (1x1 + 2x2 grids)
+    p1 = F.layers.pool2d(img, pool_size=8, pool_stride=8, pool_type="max")
+    p2 = F.layers.pool2d(img, pool_size=4, pool_stride=4, pool_type="max")
+    out1 = F.layers.reshape(p1, [-1, 2])
+    out2 = F.layers.reshape(p2, [-1, 8])
+    cat = F.layers.concat([out1, out2], axis=1)
+    exe = F.Executor(F.CPUPlace())
+    exe.run(F.default_startup_program())
+    (o,) = exe.run(feed={"img": np.random.rand(3, 2, 8, 8).astype("float32")},
+                   fetch_list=[cat])
+    assert np.asarray(o).shape == (3, 10)
+
+
+def test_seq_slice_and_sub_seq():
+    """padded_sequence_slice-backed wrappers pick per-row windows."""
+    rng = np.random.RandomState(6)
+    x = paddle.layer.data(name="x",
+                          type=paddle.data_type.dense_vector_sequence(2))
+    off = paddle.layer.data(name="off", type=paddle.data_type.dense_vector(1))
+    sz = paddle.layer.data(name="sz", type=paddle.data_type.dense_vector(1))
+    out = tch.sub_seq_layer(x, off, sz)
+    pooled = paddle.layer.pooling(input=out,
+                                  pooling_type=paddle.pooling.Sum())
+    params = paddle.parameters.create(pooled)
+    seq = np.arange(10, dtype=np.float32).reshape(5, 2)
+    got = np.asarray(Inference(pooled, params).infer(
+        [[seq.tolist(), [1.0], [2.0]]], feeding={"x": 0, "off": 1, "sz": 2}))
+    # window rows 1..2 -> sum = seq[1] + seq[2]
+    np.testing.assert_allclose(got[0], seq[1] + seq[2], rtol=1e-5)
+
+
+def test_block_expand_layer():
+    import paddle_tpu as F
+
+    F.framework.reset_default_programs()
+    img = F.layers.data(name="img", shape=[1, 4, 4], dtype="float32")
+    b = F.default_main_program().global_block()
+    out = b.create_var(name="be", shape=(-1, 4, 4), dtype="float32")
+    b.append_op(type="block_expand", inputs={"X": [img]},
+                outputs={"Out": [out]},
+                attrs={"block_y": 2, "block_x": 2, "stride_y": 2,
+                       "stride_x": 2, "padding_y": 0, "padding_x": 0})
+    exe = F.Executor(F.CPUPlace())
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    (o,) = exe.run(feed={"img": x}, fetch_list=[out])
+    o = np.asarray(o)
+    assert o.shape == (1, 4, 4)  # 4 blocks of 4 values
+    np.testing.assert_allclose(o[0, 0], [0, 1, 4, 5])   # top-left block
+
+
+def test_img_conv3d_pool3d_layers():
+    x = paddle.layer.data(name="x",
+                          type=paddle.data_type.dense_vector(1 * 4 * 4 * 4))
+
+    # direct build through the wrappers on a reshaped var
+    import paddle_tpu as F
+
+    F.framework.reset_default_programs()
+    vol = F.layers.data(name="vol", shape=[1, 4, 4, 4], dtype="float32")
+    blk = F.default_main_program().global_block()
+    from paddle_tpu.v2.layer import LayerOutput
+
+    src = LayerOutput("vol_src", [], lambda ctx: vol, size=64)
+    conv = tch.img_conv3d_layer(src, filter_size=2, num_filters=3,
+                                num_channels=1, stride=2)
+    pool = tch.img_pool3d_layer(conv, pool_size=2, stride=2)
+    ctx = {}
+    out_var = pool.build(ctx)
+    exe = F.Executor(F.CPUPlace())
+    exe.run(F.default_startup_program())
+    (o,) = exe.run(feed={"vol": np.random.rand(2, 1, 4, 4, 4).astype("float32")},
+                   fetch_list=[out_var])
+    assert np.asarray(o).shape == (2, 3, 1, 1, 1)
