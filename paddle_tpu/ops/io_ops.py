@@ -98,3 +98,21 @@ def _cond(ctx):
         t, f = unwrap(t), unwrap(f)
         m = mask.reshape((-1,) + (1,) * (t.ndim - 1))
         outer[n] = jnp.where(m, t, f)
+
+
+@register_op("print", inputs=("X",), stop_gradient=True)
+def _print(ctx):
+    """Host-side value printing mid-program (reference: the v1
+    PrintLayer; fluid later added a Print op) via ordered io_callback;
+    lowers to identity on the value path."""
+    x = unwrap(ctx.input("X"))
+    message = ctx.attr("message", "")
+
+    def host_print(arr):
+        import numpy as np
+
+        print(f"[print {message}]", np.asarray(arr), flush=True)
+        return np.int32(0)
+
+    io_callback(host_print, jnp.zeros((), jnp.int32), x, ordered=True)
+    ctx.set_output("Out", ctx.input("X"))

@@ -583,22 +583,10 @@ def print_layer(input, format=None, name=None, **kw):
     inputs = input if isinstance(input, (list, tuple)) else [input]
 
     def build(ctx, *vals):
-        import jax
-
         first = vals[0]
-        v = _unwrap(first)
-
-        def host_print(arr):
-            print(f"[print_layer {name or ''}]", arr)
-            import numpy as np
-
-            return np.int32(0)
-
-        import jax.numpy as jnp
-        from jax.experimental import io_callback
-
-        io_callback(host_print, jnp.zeros((), jnp.int32), v, ordered=True)
-        return first
+        out = _op("print", {"X": [_unwrap(first)]},
+                  {"message": name or ""})
+        return _rewrap_like(first, out)
 
     return _simple("print", list(inputs), build, size=inputs[0].size,
                    is_seq=inputs[0].is_seq, name=name)

@@ -237,3 +237,16 @@ def test_img_conv3d_pool3d_layers():
     (o,) = exe.run(feed={"vol": np.random.rand(2, 1, 4, 4, 4).astype("float32")},
                    fetch_list=[out_var])
     assert np.asarray(o).shape == (2, 3, 1, 1, 1)
+
+
+def test_print_and_eos_layers():
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(3))
+    p = tch.print_layer(x, name="dbg")
+    got = _infer(p, [[np.array([1, 2, 3], np.float32).tolist()]])
+    np.testing.assert_allclose(got.ravel(), [1, 2, 3])  # identity
+
+    fluid.framework.reset_default_programs()
+    ids = paddle.layer.data(name="ids", type=paddle.data_type.integer_value(5))
+    e = tch.eos_layer(ids, eos_id=2)
+    got = _infer(e, [[[2]], [[3]]])
+    np.testing.assert_allclose(np.asarray(got).ravel(), [1.0, 0.0])
