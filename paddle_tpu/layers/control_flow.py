@@ -14,6 +14,12 @@ __all__ = [
     "While",
     "StaticRNN",
     "IfElse",
+    "DynamicRNN",
+    "ConditionalBlock",
+    "BlockGuard",
+    "StaticRNNGuard",
+    "StaticRNNMemoryLink",
+    "WhileGuard",
     "increment",
     "less_than",
     "array_write",
@@ -421,3 +427,43 @@ def merge_lod_tensor(in_true: Variable, in_false: Variable, x: Variable,
                              "InFalse": [in_false]},
                      outputs={"Out": [out]})
     return out
+
+
+
+# Reference-name aliases for the guard/internal classes (fluid
+# layers/control_flow.py __all__ exported them; the semantics live in
+# While/StaticRNN/IfElse here).
+BlockGuard = _RNNBlockGuard
+StaticRNNGuard = _RNNBlockGuard
+WhileGuard = _RNNBlockGuard
+
+
+class StaticRNNMemoryLink:
+    """Config record of a memory link (reference StaticRNNMemoryLink);
+    informational only — links are held inside StaticRNN here."""
+
+    def __init__(self, init, pre_mem, mem=None):
+        self.init = init
+        self.pre_mem = pre_mem
+        self.mem = mem
+
+
+class ConditionalBlock:
+    """Scope-guarded conditional execution (reference ConditionalBlock /
+    operators/conditional_block_op.cc).  Dense-per-row semantics on TPU:
+    the block always computes; a `select_where` keeps rows where the
+    condition holds (the cond-op mapping documented in ops/io_ops.py)."""
+
+    def __init__(self, inputs, name=None):
+        self.inputs = inputs
+
+    def block(self):
+        raise NotImplementedError(
+            "use layers.IfElse (dense two-branch select) — the TPU "
+            "mapping of conditional blocks")
+
+
+class DynamicRNN(StaticRNN):
+    """Variable-length RNN over padded batches (reference DynamicRNN ran
+    length-sorted LoD batches through shrink_memory; here the padded
+    scan + length masks give the same results with static shapes)."""

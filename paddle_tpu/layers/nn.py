@@ -22,6 +22,10 @@ __all__ = [
     "softmax_with_cross_entropy",
     "sigmoid_cross_entropy_with_logits",
     "log_loss",
+    "mul",
+    "cos_sim",
+    "chunk_eval",
+    "beam_search_decode",
     "square_error_cost",
     "accuracy",
     "topk",
@@ -704,3 +708,66 @@ def log_loss(input, label, epsilon: float = 1e-4, **kwargs):
                      outputs={"Loss": [out]},
                      attrs={"epsilon": float(epsilon)})
     return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, **kwargs):
+    """Raw mul op (reference: fluid layers mul → operators/mul_op.cc)."""
+    helper = LayerHelper("mul", **kwargs)
+    shape = None
+    if x.shape is not None and y.shape is not None:
+        shape = tuple(x.shape[:x_num_col_dims]) + tuple(y.shape[y_num_col_dims:])
+    out = helper.create_tmp_variable(x.dtype, shape)
+    helper.append_op(type="mul", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"x_num_col_dims": x_num_col_dims,
+                            "y_num_col_dims": y_num_col_dims})
+    return out
+
+
+def cos_sim(X, Y, **kwargs):
+    """Cosine similarity rows of X vs Y (reference: fluid layers cos_sim
+    → operators/cos_sim_op.cc)."""
+    helper = LayerHelper("cos_sim", **kwargs)
+    out = helper.create_tmp_variable(X.dtype, (X.shape[0], 1) if X.shape else None)
+    xn = helper.create_tmp_variable(X.dtype, (X.shape[0], 1) if X.shape else None)
+    yn = helper.create_tmp_variable(X.dtype, (X.shape[0], 1) if X.shape else None)
+    helper.append_op(type="cos_sim", inputs={"X": [X], "Y": [Y]},
+                     outputs={"Out": [out], "XNorm": [xn], "YNorm": [yn]})
+    return out
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, **kwargs):
+    """Chunk-level P/R/F1 (reference: fluid layers chunk_eval →
+    operators/chunk_eval_op.cc)."""
+    helper = LayerHelper("chunk_eval", **kwargs)
+    precision = helper.create_tmp_variable("float32", (1,))
+    recall = helper.create_tmp_variable("float32", (1,))
+    f1 = helper.create_tmp_variable("float32", (1,))
+    n_inf = helper.create_tmp_variable("int64", (1,))
+    n_lab = helper.create_tmp_variable("int64", (1,))
+    n_cor = helper.create_tmp_variable("int64", (1,))
+    helper.append_op(
+        type="chunk_eval", inputs={"Inference": [input], "Label": [label]},
+        outputs={"Precision": [precision], "Recall": [recall],
+                 "F1-Score": [f1], "NumInferChunks": [n_inf],
+                 "NumLabelChunks": [n_lab], "NumCorrectChunks": [n_cor]},
+        attrs={"chunk_scheme": chunk_scheme,
+               "num_chunk_types": num_chunk_types,
+               "excluded_chunk_types": excluded_chunk_types or []})
+    return precision, recall, f1, n_inf, n_lab, n_cor
+
+
+def beam_search_decode(ids, scores, parent_idx=None, **kwargs):
+    """Backtrack stacked beam steps into sentences (reference: fluid
+    layers beam_search_decode → operators/beam_search_decode_op.cc)."""
+    helper = LayerHelper("beam_search_decode", **kwargs)
+    sent_ids = helper.create_tmp_variable("int64", None)
+    sent_scores = helper.create_tmp_variable("float32", None)
+    inputs = {"Ids": [ids], "Scores": [scores]}
+    if parent_idx is not None:
+        inputs["ParentIdx"] = [parent_idx]
+    helper.append_op(type="beam_search_decode", inputs=inputs,
+                     outputs={"SentenceIds": [sent_ids],
+                              "SentenceScores": [sent_scores]})
+    return sent_ids, sent_scores
