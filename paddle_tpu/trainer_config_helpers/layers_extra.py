@@ -38,6 +38,7 @@ __all__ = [
     "img_conv3d_layer", "img_pool3d_layer", "scale_sub_region_layer",
     "cross_entropy_with_selfnorm", "BaseGeneratedInput",
     "block_expand_layer", "sub_seq_layer", "sub_nested_seq_layer",
+    "conv_projection", "conv_operator",
 ]
 
 
@@ -899,3 +900,64 @@ def sub_seq_layer(input, offsets, sizes, name=None, **kw):
 
 
 sub_nested_seq_layer = sub_seq_layer
+
+
+def conv_projection(input, filter_size, num_filters, num_channels=None,
+                    stride=1, padding=0, param_attr=None, **kw):
+    """Conv-as-projection inside mixed_layer (reference ConvProjection):
+    the input (flat B, C*H*W) is reshaped to an image, convolved with a
+    learned filter, and re-flattened to the mixed size."""
+    from paddle_tpu.trainer_config_helpers.layers import (_Projection,
+                                                          _to_image)
+
+    def _pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+
+    def build(ctx, x, mixed_size):
+        import math
+
+        from paddle_tpu import layers as L
+        from paddle_tpu.layer_helper import LayerHelper
+
+        c = num_channels or 1
+        img = _to_image(ctx, x, input, c)
+        helper = LayerHelper("conv_proj", param_attr=param_attr)
+        ks = _pair(filter_size)
+        w = helper.create_parameter(param_attr,
+                                    shape=[num_filters, c] + ks,
+                                    dtype="float32")
+        out = _op("conv2d", {"Input": [img], "Filter": [w]},
+                  {"strides": _pair(stride), "paddings": _pair(padding),
+                   "dilations": [1, 1], "groups": 1}, out_slot="Output")
+        return L.reshape(out, [-1, mixed_size]) if mixed_size else out
+
+    return _Projection(input, build, out_size=None)
+
+
+def conv_operator(img, filter, filter_size, num_filters,
+                  num_channels=None, stride=1, padding=0, filter_size_y=None,
+                  stride_y=None, padding_y=None, **kw):
+    """Conv whose FILTER comes from another layer (reference
+    ConvOperator in mixed_layer — used for attention-style dynamic
+    filters).  `filter`'s output supplies num_filters*C*kh*kw weights
+    per batch row; row 0's filter is applied (the reference shared one
+    filter across the batch the same way)."""
+    fh = filter_size_y or filter_size
+    fw = filter_size
+
+    def build(ctx, x, f):
+        from paddle_tpu import layers as L
+        from paddle_tpu.trainer_config_helpers.layers import _to_image
+
+        c = num_channels or 1
+        imgv = _to_image(ctx, _unwrap(x), img, c)
+        fv = L.reshape(_unwrap(f), [-1, num_filters, c, int(fh), int(fw)])
+        f0 = _op("slice_tensor", {"X": [fv]},
+                 {"starts": [0], "ends": [1], "axes": [0]})
+        f2 = L.reshape(f0, [num_filters, c, int(fh), int(fw)])
+        return _op("conv2d", {"Input": [imgv], "Filter": [f2]},
+                   {"strides": [stride, stride_y or stride],
+                    "paddings": [padding, padding_y or padding],
+                    "dilations": [1, 1], "groups": 1}, out_slot="Output")
+
+    return _simple("conv_op", [img, filter], build)

@@ -250,3 +250,18 @@ def test_print_and_eos_layers():
     e = tch.eos_layer(ids, eos_id=2)
     got = _infer(e, [[[2]], [[3]]])
     np.testing.assert_allclose(np.asarray(got).ravel(), [1.0, 0.0])
+
+
+def test_conv_projection_in_mixed():
+    """mixed_layer += conv_projection builds and runs (reference
+    ConvProjection inside MixedLayer)."""
+    x = paddle.layer.data(name="x",
+                          type=paddle.data_type.dense_vector(1 * 6 * 6))
+    nf, hw = 2, 6  # stride1 pad1 k3 keeps 6x6
+    with tch.mixed_layer(size=nf * hw * hw) as m:
+        m += tch.conv_projection(x, filter_size=3, num_filters=nf,
+                                 num_channels=1, stride=1, padding=1)
+    out = m._lo if hasattr(m, "_lo") else m
+    got = _infer(out, [[np.random.RandomState(7).rand(36).tolist()]])
+    assert got.shape == (1, nf * hw * hw)
+    assert np.all(np.isfinite(got))
