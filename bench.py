@@ -65,6 +65,32 @@ def run(batch=256, image=(3, 224, 224), class_dim=1000, steps=20, warmup=3):
     return batch * steps / dt, loss_val
 
 
+# Nominal bf16 peak TFLOPS by device kind.  MFU here is the honest
+# model-FLOPs utilization vs the marketing peak; note the *achievable*
+# matmul roofline is lower (benchmark/peak_matmul.py measures ~132
+# TFLOPS sustained on this tunnel's v5e chip, i.e. ~67% of nominal —
+# see PERF.md for the step-time decomposition).
+_PEAK_TFLOPS = {  # longest-prefix entries first: "TPU v5e" before "TPU v5"
+    "TPU v5 lite": 197, "TPU v5e": 197, "TPU v5p": 459,
+    "TPU v6 lite": 918, "TPU v6e": 918,
+    "TPU v2": 45, "TPU v3": 123, "TPU v4": 275, "TPU v5": 459,
+}
+
+_RESNET50_TRAIN_GFLOP_PER_IMG = 12.3  # ~3x the 4.1 GFLOP fwd at 224x224
+
+
+def _mfu(ips: float) -> float:
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    peak = next((v for k, v in _PEAK_TFLOPS.items() if kind.startswith(k)), None)
+    if peak is None:
+        return -1.0
+    if os.environ.get("BENCH_AMP", "1") != "1":
+        peak /= 2  # f32 run: the MXU's f32 rate is half the bf16 peak
+    return ips * _RESNET50_TRAIN_GFLOP_PER_IMG * 1e9 / (peak * 1e12)
+
+
 def main():
     baseline = 84.08  # img/s, reference ResNet-50 BS=256 train (see header)
     batch = int(os.environ.get("BENCH_BATCH", "256"))
@@ -81,6 +107,7 @@ def main():
         "value": round(ips, 2),
         "unit": "images/sec",
         "vs_baseline": round(ips / baseline, 2),
+        "mfu": round(_mfu(ips), 4),
     }))
 
 

@@ -1,0 +1,31 @@
+"""Measure achievable bf16 matmul TFLOPS on this chip (roofline probe)."""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+N = 8192
+a = jax.random.normal(jax.random.key(0), (N, N), jnp.bfloat16)
+b = jax.random.normal(jax.random.key(1), (N, N), jnp.bfloat16)
+
+
+@jax.jit
+def f(a, b):
+    c = a
+    for _ in range(8):
+        c = c @ b
+    return c
+
+
+c = f(a, b)
+jax.block_until_ready(c)
+t0 = time.perf_counter()
+reps = 5
+for _ in range(reps):
+    c = f(a, b)
+jax.block_until_ready(c)
+dt = time.perf_counter() - t0
+flops = 2 * N**3 * 8 * reps
+print(json.dumps({"tflops": round(flops / dt / 1e12, 1),
+                  "device": jax.devices()[0].device_kind}))

@@ -1,0 +1,91 @@
+"""Parse a jax.profiler xplane trace into a per-op time table.
+
+Usage:
+    python benchmark/xprof.py /tmp/jaxtrace            # newest trace under dir
+    python benchmark/xprof.py path/to/*.xplane.pb
+
+Groups XLA op events by fusion/op category so the output answers "where
+does the step time go" without TensorBoard (which this image's
+tensorboard-plugin-profile build cannot serve).
+"""
+
+import collections
+import glob
+import os
+import re
+import sys
+
+
+def load_xspace(path):
+    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+    return xs
+
+
+def find_trace(arg):
+    if arg.endswith(".pb"):
+        return arg
+    hits = sorted(glob.glob(arg + "/**/*.xplane.pb", recursive=True))
+    if not hits:
+        raise SystemExit(f"no .xplane.pb under {arg}")
+    return hits[-1]
+
+
+_CATEGORY_RULES = [
+    (re.compile(r"convolution|conv(\.|$|\d)"), "conv"),
+    (re.compile(r"dot(\.|$|\d)|matmul"), "matmul"),
+    (re.compile(r"all-reduce|all-gather|reduce-scatter|collective|permute"), "collective"),
+    (re.compile(r"copy|transpose|bitcast"), "copy/transpose"),
+    (re.compile(r"reduce-window|select-and-scatter"), "pooling"),
+    (re.compile(r"reduce"), "reduce"),
+    (re.compile(r"fusion|fused"), "fusion(elementwise)"),
+    (re.compile(r"infeed|outfeed|send|recv"), "io"),
+]
+
+
+def categorize(name):
+    # only the instruction name left of " = " — the full text includes
+    # operand names, which would mis-categorize (e.g. any fusion fed by
+    # a copy-done would count as "copy")
+    low = name.split(" = ")[0].lower()
+    for rx, cat in _CATEGORY_RULES:
+        if rx.search(low):
+            return cat
+    return "other"
+
+
+def main():
+    path = find_trace(sys.argv[1] if len(sys.argv) > 1 else "/tmp/jaxtrace")
+    xs = load_xspace(path)
+    for plane in xs.planes:
+        if not plane.name.startswith("/device:"):
+            continue
+        meta = plane.event_metadata
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            by_name = collections.Counter()
+            by_cat = collections.Counter()
+            total = 0
+            for ev in line.events:
+                name = meta[ev.metadata_id].name
+                dur = ev.duration_ps / 1e6  # -> us
+                by_name[name] += dur
+                by_cat[categorize(name)] += dur
+                total += dur
+            print(f"== {plane.name}  total busy {total/1e3:.2f} ms "
+                  f"({len(line.events)} events)")
+            print("-- by category")
+            for cat, t in by_cat.most_common():
+                print(f"  {t/total*100:6.2f}%  {t/1e3:9.3f} ms  {cat}")
+            print("-- top ops")
+            for name, t in by_name.most_common(28):
+                print(f"  {t/total*100:6.2f}%  {t/1e3:9.3f} ms  {name[:76]}")
+
+
+if __name__ == "__main__":
+    main()

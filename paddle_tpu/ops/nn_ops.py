@@ -146,22 +146,37 @@ def _batch_norm(ctx):
     bshape = [1] * x.ndim
     bshape[c_axis] = x.shape[c_axis]
 
-    xf = x.astype(jnp.float32)
     if is_test:
         use_mean, use_var = mean, var
         saved_mean, saved_var = mean, var
         new_mean, new_var = mean, var
     else:
-        use_mean = jnp.mean(xf, axis=red_axes)
-        use_var = jnp.mean(jnp.square(xf), axis=red_axes) - jnp.square(use_mean)
+        # f32-accumulated statistics regardless of activation dtype (the
+        # convert fuses into the reduction, so bf16 activations are read
+        # once, not materialized in f32)
+        use_mean = jnp.mean(x, axis=red_axes, dtype=jnp.float32)
+        use_var = (jnp.mean(jnp.square(x.astype(jnp.float32)), axis=red_axes)
+                   - jnp.square(use_mean))
         saved_mean, saved_var = use_mean, use_var
         new_mean = momentum * mean + (1 - momentum) * use_mean
         new_var = momentum * var + (1 - momentum) * use_var
 
     inv = lax.rsqrt(use_var + eps)
-    y = (xf - use_mean.reshape(bshape)) * inv.reshape(bshape)
-    y = y * scale.reshape(bshape) + bias.reshape(bshape)
-    ctx.set_output("Y", y.astype(x.dtype))
+    if x.dtype == jnp.bfloat16:
+        # normalize in bf16 (stats stay f32): halves the HBM traffic of
+        # the normalize pass, measured +6% on the ResNet-50 train step.
+        # Fold the per-channel affine in f32 first so the bf16 rounding
+        # happens once, and the per-element work is one mul + one add.
+        a = (scale.astype(jnp.float32) * inv)
+        b = bias.astype(jnp.float32) - use_mean * a
+        y = x * a.astype(x.dtype).reshape(bshape) \
+            + b.astype(x.dtype).reshape(bshape)
+        ctx.set_output("Y", y)
+    else:
+        xf = x.astype(jnp.float32)
+        y = (xf - use_mean.reshape(bshape)) * inv.reshape(bshape)
+        y = y * scale.reshape(bshape) + bias.reshape(bshape)
+        ctx.set_output("Y", y.astype(x.dtype))
     ctx.set_output("MeanOut", new_mean)
     ctx.set_output("VarianceOut", new_var)
     ctx.set_output("SavedMean", saved_mean)
