@@ -231,7 +231,7 @@ class Executor:
             self.place,
             id(self.strategy),
             amp.is_enabled(),
-            pk.is_enabled(),
+            pk.mode(),
             pk.interpret_mode(),
         )
         compiled = self._cache.get(key)

@@ -48,7 +48,7 @@ def _mul(ctx):
     out = None
     from paddle_tpu import pallas as pk
 
-    if pk.is_enabled():
+    if pk.use_matmul():
         from paddle_tpu.pallas import matmul as pk_mm
 
         m, k = x2.shape

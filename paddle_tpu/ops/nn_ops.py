@@ -217,13 +217,10 @@ def _softmax(ctx):
     x = unwrap(unary_in)
     from paddle_tpu import pallas as pk
 
-    if pk.is_enabled() and x.ndim == 2:
-        from paddle_tpu.pallas import softmax as pk_sm
-
-        if pk_sm.fits(x.shape[0], x.shape[1]):
-            ctx.set_output("Out", rewrap(
-                unary_in, pk.pallas_softmax(x, interpret=pk.interpret_mode())))
-            return
+    if x.ndim == 2 and pk.use_softmax(x.shape[0], x.shape[1]):
+        ctx.set_output("Out", rewrap(
+            unary_in, pk.pallas_softmax(x, interpret=pk.interpret_mode())))
+        return
     ctx.set_output("Out", rewrap(unary_in, jax.nn.softmax(x, axis=-1)))
 
 

@@ -207,7 +207,21 @@ def _lstm(ctx):
     xs = jnp.swapaxes(x, 0, 1)  # (T, B, 4H)
     if ctx.attr("is_reverse", False):
         xs = xs[::-1]
-    (_, _), (hs, cs) = lax.scan(step, (h0, c0), xs)
+
+    from paddle_tpu import pallas as pk
+
+    default_acts = (ctx.attr("gate_activation", "sigmoid") == "sigmoid"
+                    and ctx.attr("cell_activation", "tanh") == "tanh"
+                    and ctx.attr("candidate_activation", "tanh") == "tanh")
+    if default_acts and not use_peepholes and pk.use_lstm(B, H):
+        from paddle_tpu.pallas import lstm as pk_lstm
+
+        bias_vec = (b_gate if bias is not None
+                    else jnp.zeros((1, 4 * H), x.dtype))
+        hs, cs = pk_lstm.lstm_seq(
+            xs, w, bias_vec, h0, c0, pk.interpret_mode())
+    else:
+        (_, _), (hs, cs) = lax.scan(step, (h0, c0), xs)
     if ctx.attr("is_reverse", False):
         hs, cs = hs[::-1], cs[::-1]
     hidden = jnp.swapaxes(hs, 0, 1)  # (B, T, H)

@@ -184,7 +184,7 @@ def _lookup_table(ctx):
     out = None
     from paddle_tpu import pallas as pk
 
-    if pk.is_enabled() and flat.ndim == 1:
+    if pk.use_gather() and flat.ndim == 1:
         from paddle_tpu.pallas import embedding as pk_emb
 
         if pk_emb.fits(flat.shape[0], w.shape[1]):

@@ -2,36 +2,100 @@
 reference's hand-written CUDA kernels — paddle/operators/math/*.cu,
 paddle/cuda/src/hl_cuda_lstm.cu etc. — reimplemented for the MXU/VPU).
 
-Kernels are opt-in (``enable()`` or PADDLE_TPU_USE_PALLAS=1): the XLA
-lowerings are already fused and fast, so each kernel must earn its
-place; they also run under ``interpret=True`` on CPU for numerics
-tests.  Op lowerings consult ``use_for(shape)`` and fall back to jnp
-whenever a shape doesn't tile cleanly."""
+Policy (PADDLE_TPU_USE_PALLAS, default ``auto``):
+
+- ``auto``: only the kernels with a *measured* win over their XLA
+  lowering dispatch (see benchmark/pallas_bench.py, PALLAS_BENCH.md):
+  the fused whole-sequence LSTM (1.2-1.6x at the RNN-bench shapes) and
+  the row softmax for narrow rows (1.5x at cols<=256).  The blocked
+  matmul and scalar-prefetch gather measurably LOSE to XLA on TPU
+  (0.6-0.9x) and are never auto-dispatched — they remain as tested
+  reference kernels and custom-epilogue scaffolds.
+- ``1``/``on``: force every kernel on (benchmarking, tests).
+- ``0``/``off``: pure XLA lowerings.
+
+All kernels run under ``interpret=True`` on CPU for numerics tests.
+"""
 
 from __future__ import annotations
 
 import os
 
+_MODE_ENV = os.environ.get("PADDLE_TPU_USE_PALLAS", "auto").lower()
 _STATE = {
-    "enabled": os.environ.get("PADDLE_TPU_USE_PALLAS", "0") == "1",
+    "mode": {"1": "on", "on": "on", "0": "off", "off": "off"}.get(
+        _MODE_ENV, "auto"),
     "interpret": os.environ.get("PADDLE_TPU_PALLAS_INTERPRET", "0") == "1",
 }
 
 
-def enable(flag: bool = True, interpret: bool | None = None):
-    _STATE["enabled"] = bool(flag)
+def enable(flag=True, interpret: bool | None = None):
+    """enable(True)='on', enable(False)='off', enable('auto')='auto'.
+    Strings follow the env convention: '1'/'on', '0'/'off', 'auto'."""
+    if isinstance(flag, str):
+        norm = {"1": "on", "on": "on", "true": "on",
+                "0": "off", "off": "off", "false": "off",
+                "auto": "auto"}.get(flag.lower())
+        if norm is None:
+            raise ValueError(f"pallas.enable: unknown mode {flag!r}")
+        _STATE["mode"] = norm
+    else:
+        _STATE["mode"] = "on" if flag else "off"
     if interpret is not None:
         _STATE["interpret"] = bool(interpret)
 
 
-def is_enabled() -> bool:
-    return _STATE["enabled"]
+def mode() -> str:
+    return _STATE["mode"]
 
 
 def interpret_mode() -> bool:
     return _STATE["interpret"]
 
 
+def _tpu_backend() -> bool:
+    try:
+        import jax
+
+        return jax.default_backend() not in ("cpu", "gpu")
+    except Exception:
+        return False
+
+
+def _auto_ok() -> bool:
+    # auto mode dispatches real kernels only on a TPU backend; interpret
+    # mode works anywhere (CPU numerics tests set it explicitly)
+    return _STATE["interpret"] or _tpu_backend()
+
+
+def use_lstm(b: int, h: int) -> bool:
+    from paddle_tpu.pallas import lstm as _l
+
+    if _STATE["mode"] == "off" or not _l.fits(b, h):
+        return False
+    if _STATE["mode"] == "on":
+        return True
+    return _auto_ok() and h <= 384  # measured: XLA wins at H>=512
+
+
+def use_softmax(rows: int, cols: int) -> bool:
+    from paddle_tpu.pallas import softmax as _s
+
+    if _STATE["mode"] == "off" or not _s.fits(rows, cols):
+        return False
+    if _STATE["mode"] == "on":
+        return True
+    return _auto_ok() and cols <= 256  # measured: XLA wins at 512
+
+def use_matmul() -> bool:
+    return _STATE["mode"] == "on"  # measured 0.6-0.9x vs XLA: never auto
+
+
+def use_gather() -> bool:
+    return _STATE["mode"] == "on"  # measured 0.5x vs XLA: never auto
+
+
 from paddle_tpu.pallas.matmul import matmul as pallas_matmul  # noqa: E402
 from paddle_tpu.pallas.softmax import softmax as pallas_softmax  # noqa: E402
 from paddle_tpu.pallas.embedding import gather_rows as pallas_gather_rows  # noqa: E402
+from paddle_tpu.pallas.lstm import lstm_seq as pallas_lstm_seq  # noqa: E402

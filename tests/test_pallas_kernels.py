@@ -81,7 +81,82 @@ def test_op_lowering_uses_pallas_and_trains(rng):
         rng.seed(42)
         with_pallas = build_and_run()
     finally:
-        pk.enable(False, interpret=False)
+        pk.enable("auto", interpret=False)
     np.testing.assert_allclose(base[0], with_pallas[0], atol=1e-4)
     # loss decreased in both modes (grads flowed through custom vjp)
     assert with_pallas[1] < with_pallas[0]
+
+
+def _lstm_scan_ref(xp, w, b, h0, c0):
+    from jax import lax
+
+    def step(carry, xt):
+        h, c = carry
+        gates = xt + h @ w + b
+        i, f, g, o = jnp.split(gates, 4, -1)
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), (h, c)
+
+    _, (hs, cs) = lax.scan(step, (h0, c0), xp)
+    return hs, cs
+
+
+def test_lstm_kernel_numerics_and_grad(rng):
+    from paddle_tpu.pallas.lstm import lstm_seq
+
+    T, B, H = 5, 8, 128
+    xp = jnp.asarray(rng.randn(T, B, 4 * H).astype("float32")) * 0.5
+    w = jnp.asarray(rng.randn(H, 4 * H).astype("float32")) * 0.1
+    b = jnp.asarray(rng.randn(4 * H).astype("float32")) * 0.1
+    h0 = jnp.asarray(rng.randn(B, H).astype("float32")) * 0.5
+    c0 = jnp.asarray(rng.randn(B, H).astype("float32")) * 0.5
+
+    hs_r, cs_r = _lstm_scan_ref(xp, w, b, h0, c0)
+    hs_p, cs_p = lstm_seq(xp, w, b, h0, c0, True)
+    np.testing.assert_allclose(np.asarray(hs_p), np.asarray(hs_r), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(cs_p), np.asarray(cs_r), atol=1e-6)
+
+    def loss(fn):
+        def f(args):
+            hs, cs = fn(*args)
+            return jnp.sum(hs ** 2) + jnp.sum(cs[-1] ** 2)
+        return f
+
+    gr = jax.grad(loss(_lstm_scan_ref))((xp, w, b, h0, c0))
+    gp = jax.grad(loss(lambda *a: lstm_seq(*a, True)))((xp, w, b, h0, c0))
+    for a, p in zip(gr, gp):
+        np.testing.assert_allclose(np.asarray(p), np.asarray(a),
+                                   atol=5e-5, rtol=1e-4)
+
+
+def test_lstm_op_pallas_path_matches_scan(rng):
+    """The fused lstm op through the registry: pallas(interpret) output
+    must equal the lax.scan lowering exactly."""
+    def run_once():
+        fluid.framework.reset_default_programs()
+        from paddle_tpu import executor as em
+
+        em._global_scope = em.Scope()
+        em._scope_stack = [em._global_scope]
+        B, T, H = 8, 6, 128
+        xp = fluid.layers.data(name="xp", shape=[T, 4 * H], dtype="float32")
+        hidden, cell = fluid.layers.dynamic_lstm(
+            input=xp, size=H, use_peepholes=False)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        feed = {"xp": rng.randn(B, T, 4 * H).astype("float32") * 0.3}
+        h, c = exe.run(feed=feed, fetch_list=[hidden, cell])
+        return np.asarray(h), np.asarray(c)
+
+    rng.seed(7)
+    pk.enable(False)
+    try:
+        h_scan, c_scan = run_once()
+        pk.enable(True, interpret=True)
+        rng.seed(7)
+        h_pal, c_pal = run_once()
+    finally:
+        pk.enable("auto", interpret=False)
+    np.testing.assert_allclose(h_pal, h_scan, atol=1e-6)
+    np.testing.assert_allclose(c_pal, c_scan, atol=1e-6)
