@@ -1,16 +1,21 @@
 """Model benchmark runner (reference: benchmark/paddle/image/*.py —
 AlexNet/GoogLeNet/VGG/ResNet/smallnet configs timed by run.sh — and
-benchmark/paddle/rnn/rnn.py for the LSTM text model; published numbers
-in benchmark/README.md + IntelOptimizedPaddle.md, mirrored in
+benchmark/paddle/rnn/rnn.py for the 2-layer LSTM IMDB model; published
+numbers in benchmark/README.md + IntelOptimizedPaddle.md, mirrored in
 BASELINE.md).
 
 Usage:
-  python benchmark/run.py                    # all models, default sizes
-  python benchmark/run.py resnet50 alexnet   # a subset
-  BENCH_STEPS=20 BENCH_BATCH=64 python benchmark/run.py smallnet
+  python benchmark/run.py                      # all models, default sizes
+  python benchmark/run.py resnet50 lstm        # a subset
+  BENCH_STEPS=20 python benchmark/run.py smallnet
 
-Prints one table row + one JSON line per model:
-  {"model": ..., "batch": ..., "img_per_sec": ..., "ms_per_batch": ...}
+Feeds are staged on device once and reused (the harness TPU sits behind
+a ~30MB/s tunnel; per-step host feeds would time the tunnel, not the
+training step — same policy as bench.py).  bf16 AMP is on by default
+(BENCH_AMP=0 for f32).
+
+Prints one table row + one JSON line per model with the reference
+baseline ratio where BASELINE.md publishes a comparable config.
 """
 
 import json
@@ -22,14 +27,28 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
+# model -> (default batch, baseline ms/batch, baseline source)
+BASELINES = {
+    "alexnet":    (128, 334.0,   "K40m GPU, benchmark/README.md:33-37"),
+    "googlenet":  (128, 1149.0,  "K40m GPU, benchmark/README.md:46-50"),
+    "smallnet":   (256, 33.113,  "K40m GPU, benchmark/README.md:53-58"),
+    "vgg16":      (256, 8410.0,  "VGG-19 2xXeon6148 MKL-DNN 30.44 img/s, IntelOptimizedPaddle.md:29-36"),
+    "resnet50":   (256, 3045.0,  "2xXeon6148 MKL-DNN 84.08 img/s, IntelOptimizedPaddle.md:38-45"),
+    "lstm":       (64,  83.0,    "h=256 K40m GPU, benchmark/README.md:113-119"),
+    "lstm_h512":  (64,  184.0,   "h=512 K40m GPU, benchmark/README.md:113-119"),
+    "lstm_h1280": (64,  641.0,   "h=1280 K40m GPU, benchmark/README.md:113-119"),
+}
+
+LSTM_HIDDEN = {"lstm": 256, "lstm_h512": 512, "lstm_h1280": 1280}
+
 
 def _train_step_fn(model_name, batch):
     import paddle_tpu as fluid
     from paddle_tpu import models
 
     fluid.framework.reset_default_programs()
-    if model_name == "lstm":
-        T, emb, hid = 100, 512, 512
+    if model_name in LSTM_HIDDEN:
+        T, emb, hid = 100, 512, LSTM_HIDDEN[model_name]
         ids = fluid.layers.data(name="ids", shape=[T, 1], dtype="int64")
         label = fluid.layers.data(name="label", shape=[1], dtype="int64")
         pred = models.lstm_text_classifier(ids, class_dim=2, emb_dim=emb,
@@ -63,37 +82,53 @@ def _train_step_fn(model_name, batch):
     return exe, fluid.default_main_program(), loss, feed
 
 
-DEFAULT_BATCH = {"alexnet": 128, "googlenet": 128, "vgg16": 64,
-                 "resnet50": 64, "smallnet": 256, "lstm": 64}
+def bench_model(model_name, batch=None, steps=None, warmup=3):
+    from paddle_tpu import amp
+    import jax.numpy as jnp
 
-
-def bench_model(model_name, batch=None, steps=None, warmup=2):
+    if os.environ.get("BENCH_AMP", "1") == "1":
+        amp.enable()
     batch = batch or int(os.environ.get("BENCH_BATCH", 0)) \
-        or DEFAULT_BATCH[model_name]
+        or BASELINES[model_name][0]
     steps = steps or int(os.environ.get("BENCH_STEPS", 10))
     rng = np.random.RandomState(0)
     exe, prog, loss, feed = _train_step_fn(model_name, batch)
+    dev_feed = {k: jnp.asarray(v) for k, v in feed(rng).items()}
     for _ in range(warmup):
-        exe.run(prog, feed=feed(rng), fetch_list=[loss])
-    t0 = time.perf_counter()
+        (l,) = exe.run(prog, feed=dev_feed, fetch_list=[loss],
+                       return_numpy=False)
+    float(np.asarray(l).ravel()[0])  # sync (block_until_ready does not
+    t0 = time.perf_counter()         # block through the tunnel)
     for _ in range(steps):
-        (l,) = exe.run(prog, feed=feed(rng), fetch_list=[loss])
-    _ = float(np.asarray(l).ravel()[0])  # sync
+        (l,) = exe.run(prog, feed=dev_feed, fetch_list=[loss],
+                       return_numpy=False)
+    float(np.asarray(l).ravel()[0])
     dt = (time.perf_counter() - t0) / steps
+    base_ms = BASELINES[model_name][1]
     return {"model": model_name, "batch": batch,
             "img_per_sec": round(batch / dt, 2),
-            "ms_per_batch": round(dt * 1e3, 2)}
+            "ms_per_batch": round(dt * 1e3, 2),
+            "baseline_ms_per_batch": base_ms,
+            "vs_baseline": round(base_ms / (dt * 1e3), 2),
+            "baseline_source": BASELINES[model_name][2]}
 
 
 def main(argv=None):
-    names = (argv or sys.argv[1:]) or list(DEFAULT_BATCH)
+    names = (argv or sys.argv[1:]) or list(BASELINES)
     rows = []
     for n in names:
-        r = bench_model(n)
+        try:
+            r = bench_model(n)
+        except Exception as e:  # keep sweeping; record the failure
+            r = {"model": n, "error": f"{type(e).__name__}: {e}"}
+            print(json.dumps(r), flush=True)
+            rows.append(r)
+            continue
         rows.append(r)
         print(f"{r['model']:<10} bs={r['batch']:<4} "
               f"{r['img_per_sec']:>10.2f} img/s  "
-              f"{r['ms_per_batch']:>8.2f} ms/batch", flush=True)
+              f"{r['ms_per_batch']:>8.2f} ms/batch  "
+              f"{r['vs_baseline']:>7.2f}x baseline", flush=True)
         print(json.dumps(r), flush=True)
     return rows
 

@@ -1,0 +1,32 @@
+"""Diagnostics helpers shared by the driver entry script and tests."""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import tempfile
+
+
+@contextlib.contextmanager
+def capture_stderr_fd():
+    """Capture fd 2 — XLA's C++ compiler warnings (e.g. GSPMD's
+    "Involuntary full rematerialization") bypass ``sys.stderr``.  The
+    captured text is re-emitted on exit so outer log scrapers still see
+    it.  The yielded getter returns '' until the context exits."""
+    captured = {"text": ""}
+    saved = os.dup(2)
+    tmp = tempfile.TemporaryFile(mode="w+b")
+    sys.stderr.flush()
+    os.dup2(tmp.fileno(), 2)
+    try:
+        yield lambda: captured["text"]
+    finally:
+        sys.stderr.flush()
+        os.dup2(saved, 2)
+        os.close(saved)
+        tmp.seek(0)
+        captured["text"] = tmp.read().decode("utf-8", "replace")
+        tmp.close()
+        sys.stderr.write(captured["text"])
+        sys.stderr.flush()

@@ -202,6 +202,10 @@ def _lstm(ctx):
         c_new = f * c + i * cand
         o = gate_act(o + w_oc * c_new) if use_peepholes else gate_act(o)
         h_new = o * cell_act(c_new)
+        # keep the carry dtype stable: under amp the f32 master bias
+        # promotes the gate math to f32 while h0/c0 are bf16
+        h_new = h_new.astype(x.dtype)
+        c_new = c_new.astype(x.dtype)
         return (h_new, c_new), (h_new, c_new)
 
     xs = jnp.swapaxes(x, 0, 1)  # (T, B, 4H)
@@ -256,7 +260,7 @@ def _gru(ctx):
         uz = xt[:, : 2 * H] + jnp.dot(h, w_rz, preferred_element_type=jnp.float32).astype(x.dtype) + bias[:, : 2 * H]
         u, r = jnp.split(gate_act(uz), 2, axis=-1)
         c = cand_act(xt[:, 2 * H :] + jnp.dot(r * h, w_c, preferred_element_type=jnp.float32).astype(x.dtype) + bias[:, 2 * H :])
-        h_new = u * h + (1 - u) * c
+        h_new = (u * h + (1 - u) * c).astype(x.dtype)  # stable carry under amp
         return h_new, h_new
 
     xs = jnp.swapaxes(x, 0, 1)

@@ -51,11 +51,23 @@ def gpipe(layer_fn: Callable, stacked_params, x, *, mesh, pp_axis: str,
     """
     B = x.shape[0]
     assert B % n_microbatch == 0, (B, n_microbatch)
-    x_mb = x.reshape((n_microbatch, B // n_microbatch) + x.shape[1:])
+    # Split the batch with the dp-sharded factor MAJOR: (B,..) P(dp,..)
+    # -> (B/M, M, ..) keeps dp on dim 0 without data movement, and the
+    # swapaxes to microbatch-major is a free dim permutation for GSPMD.
+    # Reshaping directly to (M, B/M, ..) would land dp on the microbatch
+    # dim and force an involuntary full rematerialization at the
+    # shard_map boundary (each microbatch is just a batch partition, so
+    # the interleaved assignment is semantically equivalent; the inverse
+    # mapping below restores the original row order exactly).
+    x_mb = x.reshape((B // n_microbatch, n_microbatch) + x.shape[1:]
+                     ).swapaxes(0, 1)
+
+    def un_mb(out):
+        return out.swapaxes(0, 1).reshape((B,) + x.shape[1:])
 
     if mesh is None or pp_axis is None:
         out = _pipeline_local(layer_fn, stacked_params, x_mb, n_microbatch)
-        return out.reshape((B,) + x.shape[1:])
+        return un_mb(out)
 
     n_stages = mesh.shape[pp_axis]
 
@@ -106,4 +118,4 @@ def gpipe(layer_fn: Callable, stacked_params, x, *, mesh, pp_axis: str,
         run, mesh=mesh, in_specs=(pspec, xspec), out_specs=xspec,
         check_vma=False)
     out = mapped(stacked_params, x_mb)
-    return out.reshape((B,) + x.shape[1:])
+    return un_mb(out)

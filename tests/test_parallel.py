@@ -83,6 +83,25 @@ def test_gpipe_matches_sequential(rng):
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-5)
 
 
+def test_pipelined_transformer_no_involuntary_rematerialization():
+    """The dp-sharded batch must stay on its mesh axis through the GPipe
+    microbatch split: a (M, B/M) reshape order regression makes GSPMD
+    replicate-then-repartition the activations at the shard_map boundary
+    (round-1 VERDICT item 4).  The warning only reproduces on the full
+    pipelined-transformer training program (embedding + lm_head around
+    the shard_map), so this compiles exactly the dryrun's dp=2/pp=2/sp=2
+    config — verified to emit the warning on this 8-device CPU mesh
+    before the pipeline.py fix and to be silent after it."""
+    if len(jax.devices("cpu")) < 8:
+        pytest.skip("need 8 cpu devices")
+    import __graft_entry__ as graft
+    from paddle_tpu.diagnostics import capture_stderr_fd
+
+    with capture_stderr_fd() as get_err:
+        graft._dry_transformer_pipelined(jax.devices("cpu")[:8], 2, 2, 2)
+    assert "Involuntary full rematerialization" not in get_err(), get_err()
+
+
 # --- layer_norm / attention ops -------------------------------------------
 
 
