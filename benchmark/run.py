@@ -104,13 +104,17 @@ def bench_model(model_name, batch=None, steps=None, warmup=3):
                        return_numpy=False)
     float(np.asarray(l).ravel()[0])
     dt = (time.perf_counter() - t0) / steps
-    base_ms = BASELINES[model_name][1]
+    base_batch, base_ms, base_src = BASELINES[model_name]
+    # compare on throughput so a BENCH_BATCH override stays meaningful
+    # (the baseline ms/batch is only valid at its own batch size)
+    vs = (batch / dt) / (base_batch / (base_ms / 1e3))
     return {"model": model_name, "batch": batch,
             "img_per_sec": round(batch / dt, 2),
             "ms_per_batch": round(dt * 1e3, 2),
             "baseline_ms_per_batch": base_ms,
-            "vs_baseline": round(base_ms / (dt * 1e3), 2),
-            "baseline_source": BASELINES[model_name][2]}
+            "baseline_batch": base_batch,
+            "vs_baseline": round(vs, 2),
+            "baseline_source": base_src}
 
 
 def main(argv=None):

@@ -176,8 +176,12 @@ def _lstm(ctx):
     use_peepholes = ctx.attr("use_peepholes", False) and bias is not None and bias.shape[-1] == 7 * H
     b_gate = bias[..., : 4 * H].reshape(1, 4 * H) if bias is not None else 0.0
 
-    h0 = unwrap(ctx.input("H0")) if ctx.has_input("H0") else jnp.zeros((B, H), x.dtype)
-    c0 = unwrap(ctx.input("C0")) if ctx.has_input("C0") else jnp.zeros((B, H), x.dtype)
+    # initial carry in x.dtype: explicit f32 H0/C0 under amp must match
+    # the step's pinned carry dtype
+    h0 = (unwrap(ctx.input("H0")).astype(x.dtype) if ctx.has_input("H0")
+          else jnp.zeros((B, H), x.dtype))
+    c0 = (unwrap(ctx.input("C0")).astype(x.dtype) if ctx.has_input("C0")
+          else jnp.zeros((B, H), x.dtype))
 
     gate_act = _act_fn(ctx.attr("gate_activation", "sigmoid"))
     cell_act = _act_fn(ctx.attr("cell_activation", "tanh"))
@@ -252,7 +256,8 @@ def _gru(ctx):
     w_rz = w[:, : 2 * H]
     w_c = w[:, 2 * H :]
     bias = unwrap(ctx.input("Bias")).reshape(1, 3 * H) if ctx.has_input("Bias") else jnp.zeros((1, 3 * H), x.dtype)
-    h0 = unwrap(ctx.input("H0")) if ctx.has_input("H0") else jnp.zeros((B, H), x.dtype)
+    h0 = (unwrap(ctx.input("H0")).astype(x.dtype) if ctx.has_input("H0")
+          else jnp.zeros((B, H), x.dtype))  # match the pinned carry dtype
     gate_act = _act_fn(ctx.attr("gate_activation", "sigmoid"))
     cand_act = _act_fn(ctx.attr("activation", "tanh"))
 

@@ -4,7 +4,7 @@ numeric gradients)."""
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 
@@ -19,20 +19,17 @@ class OpTest:
 
     op_type: str = ""
 
-    def build_and_run(
-        self,
-        inputs: Dict,
-        attrs: Dict,
-        output_slots: Sequence[str],
-        output_meta: Optional[Dict[str, Dict]] = None,
-        fetch_grads_for: Sequence[str] = (),
-        loss_slot: Optional[str] = None,
-    ):
-        import paddle_tpu.framework as framework
+    # -- program construction ------------------------------------------------
 
-        framework.reset_default_programs()
+    def _build_forward(self, inputs: Dict, attrs: Dict,
+                       output_slots: Sequence[str],
+                       output_meta: Optional[Dict[str, Dict]] = None):
+        """Reset programs/scope and build the single-op forward program.
+        Returns (prog, block, feed, out_map, fetch)."""
+        import paddle_tpu.framework as framework
         from paddle_tpu import executor as executor_mod
 
+        framework.reset_default_programs()
         executor_mod._global_scope = executor_mod.Scope()
         executor_mod._scope_stack = [executor_mod._global_scope]
 
@@ -63,17 +60,28 @@ class OpTest:
             out_map[slot] = [name]
         block.append_op(type=self.op_type, inputs=in_map, outputs=out_map,
                         attrs=attrs)
-
         fetch = [out_map[s][0] for s in output_slots]
+        return prog, block, feed, out_map, fetch
+
+    def build_and_run(
+        self,
+        inputs: Dict,
+        attrs: Dict,
+        output_slots: Sequence[str],
+        output_meta: Optional[Dict[str, Dict]] = None,
+        fetch_grads_for: Sequence[str] = (),
+        loss_slot: Optional[str] = None,
+    ):
+        prog, block, feed, out_map, fetch = self._build_forward(
+            inputs, attrs, output_slots, output_meta)
         if fetch_grads_for:
             loss_name = out_map[loss_slot or output_slots[0]][0]
-            loss_var = block.var(loss_name)
             # reduce to scalar for backward
             mean_out = block.create_var(name="loss_mean", shape=(), dtype="float32")
             block.append_op(type="mean", inputs={"X": [loss_name]},
                             outputs={"Out": ["loss_mean"]})
             fluid.append_backward(mean_out)
-            fetch += [grad_var_name(n) for n in fetch_grads_for]
+            fetch = fetch + [grad_var_name(n) for n in fetch_grads_for]
 
         exe = fluid.Executor(fluid.CPUPlace())
         return exe.run(prog, feed=feed, fetch_list=fetch)
@@ -96,19 +104,18 @@ class OpTest:
                    loss_slot=None, delta=1e-3, atol=1e-2, rtol=1e-2,
                    output_meta=None):
         """Analytic grads (via the framework) vs central differences of a
-        mean-of-output loss."""
+        mean-of-output loss.  The numeric pass builds its program ONCE
+        and replays it with perturbed feeds (executor cache hit), so a
+        full central-difference sweep is cheap."""
         res = self.build_and_run(inputs, attrs, output_slots, output_meta,
                                  fetch_grads_for=wrt, loss_slot=loss_slot)
         analytic = res[len(output_slots):]
 
-        # numeric: perturb each wrt input
-        def loss_of(feed_override):
-            outs = self._run_plain(inputs, attrs, output_slots, output_meta,
-                                   feed_override, loss_slot)
-            return outs
+        loss_of = self._make_cached_loss(inputs, attrs, output_slots,
+                                         output_meta, loss_slot)
 
         for gname, g in zip(wrt, analytic):
-            base = self._flat_input(inputs, gname)
+            base, lod = self._flat_input(inputs, gname)
             num = np.zeros_like(base, dtype=np.float64)
             flat = base.reshape(-1)
             numf = num.reshape(-1)
@@ -116,32 +123,49 @@ class OpTest:
                 for sign in (+1, -1):
                     pert = base.copy().reshape(-1)
                     pert[i] += sign * delta
-                    numf[i] += sign * loss_of({gname: pert.reshape(base.shape)})
+                    pert = pert.reshape(base.shape)
+                    if lod is not None:
+                        pert = LoDArray(pert, lod)
+                    numf[i] += sign * loss_of({gname: pert})
                 numf[i] /= 2 * delta
             ga = np.asarray(g.data) if isinstance(g, LoDArray) else np.asarray(g)
+            from paddle_tpu.sparse import SparseGrad
+
+            if isinstance(g, SparseGrad):  # densify rowwise sparse grads
+                dense = np.zeros(base.shape, np.float64)
+                np.add.at(dense, np.asarray(g.rows), np.asarray(g.values))
+                ga = dense
             np.testing.assert_allclose(ga, num, atol=atol, rtol=rtol,
-                                       err_msg=f"grad wrt {gname}")
+                                       err_msg=f"{self.op_type}: grad wrt {gname}")
+
+    def _make_cached_loss(self, inputs, attrs, output_slots, output_meta,
+                          loss_slot):
+        """Build the forward program once; return loss_of(override)."""
+        prog, _block, feed, _out_map, fetch = self._build_forward(
+            inputs, attrs, output_slots, output_meta)
+        exe = fluid.Executor(fluid.CPUPlace())
+        loss_idx = output_slots.index(loss_slot) if loss_slot else 0
+
+        def loss_of(override):
+            f = dict(feed)
+            f.update(override)
+            outs = exe.run(prog, feed=f, fetch_list=fetch)
+            v = outs[loss_idx]
+            if isinstance(v, LoDArray):
+                v = np.asarray(v.data)
+            return float(np.mean(v))
+
+        return loss_of
 
     def _flat_input(self, inputs, name):
+        """-> (float array, lod or None) for the named input."""
         for slot, value in inputs.items():
             entries = value if isinstance(value, list) else [(f"{slot}_var", value)]
             for n, arr in entries:
                 if n == name:
-                    return np.asarray(arr, dtype=np.float64).astype(np.float32)
+                    if isinstance(arr, LoDArray):
+                        return (np.asarray(arr.data, np.float64)
+                                .astype(np.float32), arr.lod)
+                    return np.asarray(arr, dtype=np.float64).astype(
+                        np.float32), None
         raise KeyError(name)
-
-    def _run_plain(self, inputs, attrs, output_slots, output_meta, override,
-                   loss_slot):
-        new_inputs = {}
-        for slot, value in inputs.items():
-            entries = value if isinstance(value, list) else [(f"{slot}_var", value)]
-            new_entries = []
-            for n, arr in entries:
-                new_entries.append((n, override.get(n, arr)))
-            new_inputs[slot] = new_entries
-        outs = self.build_and_run(new_inputs, attrs, output_slots, output_meta)
-        loss_idx = output_slots.index(loss_slot) if loss_slot else 0
-        v = outs[loss_idx]
-        if isinstance(v, LoDArray):
-            v = np.asarray(v.data)
-        return float(np.mean(v))
