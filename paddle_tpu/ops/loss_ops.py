@@ -163,3 +163,140 @@ def _padded_sequence_cross_entropy(ctx):
     per_seq = (jnp.sum(jnp.where(valid, nll, 0.0), axis=1)
                / jnp.maximum(lens.astype(jnp.float32), 1.0))
     ctx.set_output("Out", per_seq[:, None])
+
+
+def _lambda_positions(y, o, lens, T):
+    """Sort each row's first ``lens`` entries by true score desc.
+    Returns (order, y_sorted, o_sorted, valid_positions)."""
+    valid = jnp.arange(T)[None, :] < lens[:, None]
+    key = jnp.where(valid, y, -jnp.inf)
+    order = jnp.argsort(-key, axis=1)                     # (B, T)
+    ys = jnp.take_along_axis(y, order, axis=1)
+    os_ = jnp.take_along_axis(o, order, axis=1)
+    return order, ys, os_, valid
+
+
+def _lambda_max_dcg(ys, lens, k):
+    pos = jnp.arange(ys.shape[1])[None, :]
+    k_eff = jnp.minimum(lens, k)[:, None]
+    disc = 1.0 / jnp.log(pos + 2.0)
+    gain = jnp.power(2.0, ys) - 1.0
+    return jnp.sum(jnp.where(pos < k_eff, gain * disc, 0.0), axis=1)
+
+
+def _lambda_cost_grad_lower(ctx):
+    """Hand-defined LambdaRank gradients (reference: gserver/layers/
+    CostLayer.cpp LambdaCost::calcGrad) — NOT the gradient of the NDCG
+    forward, by design."""
+    from paddle_tpu.lod import LoDArray
+
+    fwd_in = ctx.op.attr("__fwd_inputs__")
+    fwd_at = ctx.op.attr("__fwd_attrs__")
+    score_v = ctx.values[fwd_in["Score"][0]]
+    label_v = ctx.values[fwd_in["Label"][0]]
+    o = unwrap(score_v).astype(jnp.float32)
+    y = unwrap(label_v).astype(jnp.float32)
+    squeeze = o.ndim == 3
+    if squeeze:
+        o = o[..., 0]
+    if y.ndim == 3:
+        y = y[..., 0]
+    B, T = o.shape
+    if fwd_in.get("Length"):
+        lens = unwrap(ctx.values[fwd_in["Length"][0]]).reshape(-1).astype(jnp.int32)
+    else:
+        lens = jnp.full((B,), T, jnp.int32)
+    k = int(fwd_at.get("NDCG_num", 5))
+    mss = int(fwd_at.get("max_sort_size", -1))
+    gout = unwrap(ctx.input("Out@GRAD")).reshape(B, 1).astype(jnp.float32)
+
+    order, ys, os_, _valid = _lambda_positions(y, o, lens, T)
+    max_dcg = jnp.maximum(_lambda_max_dcg(ys, lens, k), 1e-12)   # (B,)
+
+    pos = jnp.arange(T)
+    p = pos[:, None]                                      # i (row)
+    q = pos[None, :]                                      # j (col)
+    sort_size = lens if mss < 0 else jnp.minimum(lens, mss)      # (B,)
+    pair_ok = ((p < q)[None]
+               & (q[None] < lens[:, None, None])
+               & (p[None] < sort_size[:, None, None]))    # (B, T, T)
+    disc_p = 1.0 / jnp.log(p + 2.0)
+    disc_q = 1.0 / jnp.log(q + 2.0)
+    gain = jnp.power(2.0, ys)                             # (B, T)
+    gdif = gain[:, :, None] - gain[:, None, :]
+    dcg_dif = jnp.where((q[None] < sort_size[:, None, None]),
+                        gdif * (disc_p - disc_q)[None],
+                        gdif * disc_p[None])
+    lam = -jnp.abs(dcg_dif) / (1.0 + jnp.exp(
+        os_[:, :, None] - os_[:, None, :]))
+    lam = jnp.where(pair_ok, lam, 0.0)
+    g_sorted = (jnp.sum(lam, axis=2) - jnp.sum(lam, axis=1)) \
+        / max_dcg[:, None]                                # (B, T)
+    # unsort back to original positions
+    grad = jnp.zeros_like(g_sorted)
+    grad = jnp.put_along_axis(grad, order, g_sorted, axis=1,
+                              inplace=False)
+    grad = grad * gout                                    # chain outer grad
+    if squeeze:
+        grad = grad[..., None]
+    gname = ctx.op.outputs.get("Score@GRAD", [None])[0]
+    if gname:
+        from paddle_tpu.lod import rewrap as _rw
+
+        ctx.values[gname] = _rw(score_v, grad.astype(unwrap(score_v).dtype))
+
+
+@register_op("lambda_cost", inputs=("Score", "Label", "Length"),
+             outputs=("Out",), diff_inputs=("Score",),
+             grad_lower=_lambda_cost_grad_lower)
+def _lambda_cost(ctx):
+    """LambdaRank listwise cost (reference: gserver/layers/CostLayer.cpp
+    LambdaCost; v1 lambda_cost).  Forward emits NDCG@k per list (what
+    the reference layer reports); backward is the hand-defined lambda
+    gradient above.  Score/Label: padded (B, T[, 1]); Length: (B,)."""
+    o = unwrap(ctx.input("Score")).astype(jnp.float32)
+    y = unwrap(ctx.input("Label")).astype(jnp.float32)
+    if o.ndim == 3:
+        o = o[..., 0]
+    if y.ndim == 3:
+        y = y[..., 0]
+    B, T = o.shape
+    if ctx.has_input("Length"):
+        lens = unwrap(ctx.input("Length")).reshape(-1).astype(jnp.int32)
+    else:
+        lens = jnp.full((B,), T, jnp.int32)
+    k = int(ctx.attr("NDCG_num", 5))
+
+    valid = jnp.arange(T)[None, :] < lens[:, None]
+    # DCG of the model ranking, maxDCG of the ideal ranking
+    order_o = jnp.argsort(-jnp.where(valid, o, -jnp.inf), axis=1)
+    y_by_o = jnp.take_along_axis(y, order_o, axis=1)
+    dcg = _lambda_max_dcg(y_by_o, lens, k)
+    _, ys, _, _ = _lambda_positions(y, o, lens, T)
+    max_dcg = jnp.maximum(_lambda_max_dcg(ys, lens, k), 1e-12)
+    ctx.set_output("Out", (dcg / max_dcg)[:, None])
+
+
+@register_op("cross_entropy_over_beam", inputs=("Scores", "Golds"),
+             outputs=("Out",))
+def _cross_entropy_over_beam(ctx):
+    """Cross entropy over beam expansions (reference: gserver/layers/
+    CrossEntropyOverBeam.cpp; v1 cross_entropy_over_beam).  Simplified
+    TPU lowering: each expansion step contributes the NLL of the gold
+    candidate under a softmax over that step's candidate scores; the
+    per-sequence cost is the sum over steps.  (The reference normalizes
+    once over all expanded *paths*; with a single expansion the two are
+    identical, and per-step normalization is the standard globally-
+    normalized-beam-training surrogate.)  Scores: list of (B, C_i);
+    Golds: list of (B, 1) int gold indices."""
+    scores = [unwrap(v) for v in ctx.inputs("Scores")]
+    golds = [unwrap(v) for v in ctx.inputs("Golds")]
+    B = scores[0].shape[0]
+    total = jnp.zeros((B,), jnp.float32)
+    for s, g in zip(scores, golds):
+        if s.ndim == 3:
+            s = s[..., 0]
+        logp = jax.nn.log_softmax(s.astype(jnp.float32), axis=-1)
+        gi = g.reshape(B, 1).astype(jnp.int32)
+        total = total - jnp.take_along_axis(logp, gi, axis=1)[:, 0]
+    ctx.set_output("Out", total[:, None])

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from paddle_tpu.lod import LoDArray, row_segment_ids, unwrap
+from paddle_tpu.lod import LoDArray, rewrap, row_segment_ids, unwrap
 from paddle_tpu.registry import register_op
 
 
@@ -76,17 +76,72 @@ def _sequence_softmax(ctx):
     ctx.set_output("Out", LoDArray(out.reshape(x.data.shape), x.lod))
 
 
-@register_op("sequence_concat", inputs=("X",))
+def _temporal_concat_pair(a: LoDArray, b: LoDArray) -> LoDArray:
+    """Concat sequence i of ``a`` with sequence i of ``b`` along time
+    (reference: operators/sequence_concat_op.cc axis=0).  Packed-row
+    re-interleave with static shapes: output row r maps to a source row
+    in [A; B] computed from the offset tables."""
+    a_off = a.last_level().astype(jnp.int32)
+    b_off = b.last_level().astype(jnp.int32)
+    na = a.data.shape[0]
+    n_out = na + b.data.shape[0]
+    out_off = a_off + b_off
+    seq = row_segment_ids(out_off, n_out)
+    seq = jnp.clip(seq, 0, a_off.shape[0] - 2)
+    pos = jnp.arange(n_out, dtype=jnp.int32) - out_off[seq]
+    a_len = a_off[seq + 1] - a_off[seq]
+    src = jnp.where(pos < a_len,
+                    a_off[seq] + pos,
+                    na + b_off[seq] + (pos - a_len))
+    both = jnp.concatenate([a.data, b.data], axis=0)
+    out = jnp.take(both, jnp.clip(src, 0, n_out - 1), axis=0)
+    lod = a.lod[:-1] + (out_off,) if len(a.lod) == len(b.lod) else (out_off,)
+    return LoDArray(out, lod)
+
+
+def _temporal_concat_padded(a, la, b, lb):
+    """Padded ragged temporal concat: out[s] = a[s, :la[s]] ++ b[s, :lb[s]],
+    zero-padded to Ta+Tb (the SeqVal twin of the packed path above)."""
+    ta, tb = a.shape[1], b.shape[1]
+    t = jnp.arange(ta + tb, dtype=jnp.int32)[None, :]      # (1, Tout)
+    la = la.reshape(-1, 1).astype(jnp.int32)
+    lb = lb.reshape(-1, 1).astype(jnp.int32)
+    rows = jnp.arange(a.shape[0])[:, None]
+    ga = a[rows, jnp.clip(t, 0, ta - 1)]
+    gb = b[rows, jnp.clip(t - la, 0, tb - 1)]
+    feat_shape = (1,) * (a.ndim - 2)
+    from_a = (t < la).reshape((a.shape[0], ta + tb) + feat_shape)
+    valid = (t < la + lb).reshape(from_a.shape)
+    return jnp.where(from_a, ga, gb) * valid.astype(a.dtype)
+
+
+@register_op("sequence_concat", inputs=("X", "Length"))
 def _sequence_concat(ctx):
-    """Concat along the feature axis for same-LoD inputs (axis=1), the
-    common case of reference sequence_concat_op."""
+    """Concat same-LoD inputs: axis=1 joins features, axis=0 joins each
+    pair of sequences along *time* (reference: operators/
+    sequence_concat_op.cc both modes).  axis=0 accepts packed LoD
+    inputs or padded (B, T, ...) inputs with optional per-input Length
+    vectors (absent = full length)."""
     xs = ctx.inputs("X")
     axis = ctx.attr("axis", 0)
     if axis == 1:
         out = jnp.concatenate([unwrap(v) for v in xs], axis=1)
-        ctx.set_output("Out", LoDArray(out, xs[0].lod))
-    else:
-        raise NotImplementedError("sequence_concat axis=0 requires re-packing; TODO")
+        ctx.set_output("Out", rewrap(xs[0], out))
+        return
+    if isinstance(xs[0], LoDArray):
+        acc = xs[0]
+        for nxt in xs[1:]:
+            acc = _temporal_concat_pair(acc, nxt)
+        ctx.set_output("Out", acc)
+        return
+    lens = ([unwrap(v) for v in ctx.inputs("Length")]
+            if ctx.has_input("Length") else
+            [jnp.full((x.shape[0],), x.shape[1], jnp.int32) for x in xs])
+    acc, lacc = unwrap(xs[0]), lens[0]
+    for nxt, ln in zip(xs[1:], lens[1:]):
+        acc = _temporal_concat_padded(acc, lacc, unwrap(nxt), ln)
+        lacc = lacc.reshape(-1) + ln.reshape(-1)
+    ctx.set_output("Out", acc)
 
 
 @register_op("seq_expand", inputs=("X", "Y"), diff_inputs=("X",))
@@ -165,9 +220,27 @@ def _lstm(ctx):
     x_in = ctx.input("Input")
     is_lod = isinstance(x_in, LoDArray)
     if is_lod:
-        raise NotImplementedError(
-            "LoD input to fused lstm: feed padded (batch, time, 4H) instead"
-        )
+        # Packed LoD rows -> padded (S, Tmax, 4H) where Tmax = N (the
+        # static bound; offsets are traced values).  Padding sits after
+        # each sequence's end, so garbage steps never contaminate valid
+        # outputs; valid rows are re-gathered into packed layout below.
+        # Callers with many sequences should pre-pad (the fast path).
+        off = x_in.last_level().astype(jnp.int32)
+        data = x_in.data                       # (N, 4H)
+        N = data.shape[0]
+        S = off.shape[0] - 1
+        t_idx = jnp.arange(N, dtype=jnp.int32)
+        lens = off[1:] - off[:-1]
+        lod_reverse = bool(ctx.attr("is_reverse", False))
+        # ragged reversal happens inside each valid window at pad time
+        src_t = (lens[:, None] - 1 - t_idx[None, :]) if lod_reverse \
+            else t_idx[None, :]
+        gather_idx = jnp.clip(off[:-1, None] + src_t, 0, N - 1)
+        valid = (t_idx[None, :] < lens[:, None])
+        x_pad = jnp.take(data, gather_idx.reshape(-1), axis=0).reshape(
+            S, N, data.shape[-1])
+        x_pad = x_pad * valid[:, :, None].astype(data.dtype)
+        x_in = x_pad
     x = unwrap(x_in)  # (B, T, 4H)
     B, T, H4 = x.shape
     H = H4 // 4
@@ -213,7 +286,9 @@ def _lstm(ctx):
         return (h_new, c_new), (h_new, c_new)
 
     xs = jnp.swapaxes(x, 0, 1)  # (T, B, 4H)
-    if ctx.attr("is_reverse", False):
+    # LoD input already reverses inside each valid window at pad time
+    whole_reverse = ctx.attr("is_reverse", False) and not is_lod
+    if whole_reverse:
         xs = xs[::-1]
 
     from paddle_tpu import pallas as pk
@@ -230,14 +305,24 @@ def _lstm(ctx):
             xs, w, bias_vec, h0, c0, pk.interpret_mode())
     else:
         (_, _), (hs, cs) = lax.scan(step, (h0, c0), xs)
-    if ctx.attr("is_reverse", False):
+    if whole_reverse:
         hs, cs = hs[::-1], cs[::-1]
     hidden = jnp.swapaxes(hs, 0, 1)  # (B, T, H)
     cell = jnp.swapaxes(cs, 0, 1)
+    if is_lod:
+        # re-gather valid steps into packed rows, same lod as the input;
+        # under is_reverse padded position p holds original time
+        # len-1-p, so the regather maps back through the same flip
+        seq = jnp.clip(row_segment_ids(off, N), 0, S - 1)
+        t = jnp.arange(N, dtype=jnp.int32) - off[seq]
+        if lod_reverse:
+            t = lens[seq] - 1 - t
+        hidden = LoDArray(hidden[seq, t], ctx.input("Input").lod)
+        cell = LoDArray(cell[seq, t], ctx.input("Input").lod)
     ctx.set_output("Hidden", hidden)
     ctx.set_output("Cell", cell)
     if ctx.has_output("BatchGate"):
-        ctx.set_output("BatchGate", x)
+        ctx.set_output("BatchGate", ctx.input("Input") if is_lod else x)
     if ctx.has_output("BatchCellPreAct"):
         ctx.set_output("BatchCellPreAct", cell)
 

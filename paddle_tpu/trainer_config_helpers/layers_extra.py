@@ -39,6 +39,7 @@ __all__ = [
     "cross_entropy_with_selfnorm", "BaseGeneratedInput",
     "block_expand_layer", "sub_seq_layer", "sub_nested_seq_layer",
     "conv_projection", "conv_operator",
+    "lambda_cost", "cross_entropy_over_beam", "BeamInput",
 ]
 
 
@@ -512,14 +513,15 @@ def detection_output_layer(input_loc, input_conf, priorbox, num_classes,
 
 def seq_concat_layer(a, b, name=None, **kw):
     def build(ctx, x, y):
-        out = _op("sequence_concat",
-                  {"X": [_unwrap(x), _unwrap(y)]})
+        ins = {"X": [_unwrap(x), _unwrap(y)]}
         lens = None
         if isinstance(x, SeqVal) and isinstance(y, SeqVal):
             from paddle_tpu import layers as L
 
+            ins["Length"] = [x.lengths, y.lengths]
             lens = _op("elementwise_add",
                        {"X": [x.lengths], "Y": [y.lengths]}, dtype="int32")
+        out = _op("sequence_concat", ins)
         return SeqVal(out, lens) if lens is not None else out
 
     return _simple("seq_concat", [a, b], build, size=a.size, is_seq=True,
@@ -961,3 +963,53 @@ def conv_operator(img, filter, filter_size, num_filters,
                     "dilations": [1, 1], "groups": 1}, out_slot="Output")
 
     return _simple("conv_op", [img, filter], build)
+
+
+# -- LambdaRank / beam-training costs (the last v1 name gaps) ---------------
+
+
+def lambda_cost(input, score, NDCG_num=5, max_sort_size=-1, name=None, **kw):
+    """LambdaRank listwise cost (reference: trainer_config_helpers
+    lambda_cost -> gserver/layers/CostLayer.cpp LambdaCost).  ``input``
+    is the model's per-item score sequence, ``score`` the ground-truth
+    relevance sequence; forward reports NDCG@NDCG_num, backward emits
+    the hand-defined lambda gradients."""
+    def build(ctx, x, y):
+        ins = {"Score": [_unwrap(x)], "Label": [_unwrap(y)]}
+        if isinstance(x, SeqVal) and x.lengths is not None:
+            ins["Length"] = [x.lengths]
+        return _op("lambda_cost", ins,
+                   {"NDCG_num": int(NDCG_num),
+                    "max_sort_size": int(max_sort_size)})
+
+    return _simple("lambda_cost", [input, score], build, size=1, name=name)
+
+
+class BeamInput(object):
+    """One beam-expansion triple for cross_entropy_over_beam (reference:
+    trainer_config_helpers BeamInput): scores over the step's
+    candidates, the selected candidate ids, and the gold index."""
+
+    def __init__(self, candidate_scores, selected_candidates, gold):
+        self.candidate_scores = candidate_scores
+        self.selected_candidates = selected_candidates
+        self.gold = gold
+
+
+def cross_entropy_over_beam(input, name=None, **kw):
+    """Cross entropy over beam expansions (reference:
+    trainer_config_helpers cross_entropy_over_beam ->
+    gserver/layers/CrossEntropyOverBeam.cpp).  ``input`` is a list of
+    BeamInput triples, one per expansion step."""
+    beams = input if isinstance(input, (list, tuple)) else [input]
+    parents = []
+    for b in beams:
+        parents += [b.candidate_scores, b.gold]
+
+    def build(ctx, *vals):
+        return _op("cross_entropy_over_beam",
+                   {"Scores": [_unwrap(v) for v in vals[0::2]],
+                    "Golds": [_unwrap(v) for v in vals[1::2]]})
+
+    return _simple("cross_entropy_over_beam", parents, build, size=1,
+                   name=name)

@@ -366,6 +366,11 @@ SPECS = {
                 "Label": [("lb", _rng(1).randint(0, 4, (2, 3)).astype(np.int64))],
                 "Length": [("ln", np.array([3, 2], np.int64))]},
         attrs={}, output_slots=["Out"], wrt=["x"]),
+    "cross_entropy_over_beam": lambda: dict(
+        inputs={"Scores": [("s1", U((3, 4))), ("s2", U((3, 5), seed=1))],
+                "Golds": [("g1", np.array([[0], [2], [3]], np.int64)),
+                          ("g2", np.array([[1], [0], [4]], np.int64))]},
+        attrs={}, output_slots=["Out"], wrt=["s1", "s2"]),
     "padded_sequence_slice": lambda: dict(
         inputs={"X": [("x", U((2, 4, 2)))],
                 "Length": [("l", np.array([4, 3], np.int64))],
@@ -441,6 +446,11 @@ SKIP = {
     # asserted in tests/test_parallel.py (gpipe grad tests)
     "transformer_pipeline_blocks":
         "composite; grad equivalence in test_parallel.py::test_gpipe_matches_sequential",
+    # LambdaRank: backward is the hand-defined lambda gradient, NOT the
+    # gradient of the NDCG forward (reference CostLayer.cpp LambdaCost);
+    # verified against a direct port in tests/test_named_gaps.py
+    "lambda_cost": "non-gradient backward by design; oracle-checked in "
+                   "tests/test_named_gaps.py",
 }
 
 
