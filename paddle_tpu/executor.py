@@ -283,8 +283,12 @@ class Executor:
     @staticmethod
     def _program_key(program: Program):
         # Cheap structural key: recompute the content hash only when the
-        # op/var counts change (programs are append-only in practice).
-        counts = tuple((len(b.ops), len(b.vars)) for b in program.blocks)
+        # op/var counts OR the attr-mutation version change (Operator
+        # attrs version-bump the program on any in-place write, so a
+        # hand-flipped ``is_test`` recompiles instead of silently
+        # reusing the stale executable).
+        counts = (tuple((len(b.ops), len(b.vars)) for b in program.blocks),
+                  getattr(program, "_version", 0))
         cached = getattr(program, "_fp_cache", None)
         if cached is not None and cached[0] == counts:
             return cached[1]

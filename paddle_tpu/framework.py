@@ -220,6 +220,59 @@ def _as_name_list(v) -> List[str]:
     return [v.name if isinstance(v, Variable) else str(v)]
 
 
+class _AttrDict(dict):
+    """Op attrs that version-bump the owning program on mutation, so the
+    executor's compile cache can detect in-place attr edits (e.g.
+    flipping ``is_test`` by hand) without rehashing every run."""
+
+    __slots__ = ("_op",)
+
+    def __init__(self, op, mapping=None):
+        super().__init__(mapping or {})
+        self._op = op
+
+    def _touch(self):
+        block = getattr(self._op, "block", None)
+        prog = getattr(block, "program", None) if block is not None else None
+        if prog is not None:
+            prog._version = getattr(prog, "_version", 0) + 1
+
+    def __setitem__(self, k, v):
+        super().__setitem__(k, v)
+        self._touch()
+
+    def __delitem__(self, k):
+        super().__delitem__(k)
+        self._touch()
+
+    def update(self, *a, **kw):
+        super().update(*a, **kw)
+        self._touch()
+
+    def pop(self, *a):
+        out = super().pop(*a)
+        self._touch()
+        return out
+
+    def setdefault(self, k, default=None):
+        out = super().setdefault(k, default)
+        self._touch()
+        return out
+
+    def clear(self):
+        super().clear()
+        self._touch()
+
+    def __deepcopy__(self, memo):
+        new = _AttrDict.__new__(_AttrDict)
+        dict.__init__(new)
+        memo[id(self)] = new  # before the _op recursion re-enters us
+        new._op = copy.deepcopy(self._op, memo)
+        for k, v in self.items():
+            dict.__setitem__(new, k, copy.deepcopy(v, memo))
+        return new
+
+
 class Operator:
     def __init__(
         self,
@@ -237,7 +290,7 @@ class Operator:
         self.outputs: Dict[str, List[str]] = {
             k: _as_name_list(v) for k, v in (outputs or {}).items()
         }
-        self.attrs: Dict[str, Any] = dict(attrs or {})
+        self.attrs: Dict[str, Any] = _AttrDict(self, attrs or {})
         # Run registry-side checks/infer-shape at append time, like the
         # reference's compile-time InferShape (framework/op_desc.cc).
         from paddle_tpu import registry
@@ -378,6 +431,7 @@ class Program:
         self.blocks: List[Block] = [Block(self, 0)]
         self.current_block_idx = 0
         self.seed: Optional[int] = None  # program-level RNG seed
+        self._version = 0  # bumped on in-place op-attr mutation
 
     # --- block management --------------------------------------------------
 
@@ -448,8 +502,11 @@ class Program:
 
     def prune(self, targets) -> "Program":
         """Dead-op elimination given fetch targets (reference:
-        framework/prune.cc).  Keeps ops whose outputs (transitively) feed a
-        target; drops the rest."""
+        framework/prune.cc, incl. its sub-block recursion at
+        prune.cc:133).  Keeps ops whose outputs (transitively) feed a
+        target; a kept control-flow op also keeps every variable its
+        sub-blocks read from the enclosing scope, even when not named in
+        the op's own inputs."""
         target_names = set(_as_name_list(targets))
         p = self.clone()
         block = p.global_block()
@@ -459,8 +516,25 @@ class Program:
             if needed & set(op.output_arg_names) or op.type in ("feed",):
                 kept.append(op)
                 needed |= set(op.input_arg_names)
+                needed |= _sub_block_external_reads(op)
         block.ops = list(reversed(kept))
         return p
+
+
+def _sub_block_external_reads(op) -> set:
+    """Variables an op's sub-blocks (Block-valued attrs) read from the
+    enclosing scope: union of sub-block op inputs (recursively) minus
+    names produced inside the sub-block (reference: prune.cc:133)."""
+    reads: set = set()
+    for v in op.attrs.values():
+        if not isinstance(v, Block):
+            continue
+        produced: set = set()
+        for sub_op in v.ops:
+            reads |= set(sub_op.input_arg_names) - produced
+            reads |= _sub_block_external_reads(sub_op)
+            produced |= set(sub_op.output_arg_names)
+    return reads
 
 
 def _ops_with_is_test(op_type: str):

