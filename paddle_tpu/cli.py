@@ -1,0 +1,148 @@
+#!/usr/bin/env python3
+"""The `paddle` command (reference: paddle/scripts/submit_local.sh.in —
+the shell wrapper exposing train / version / merge_model; plus the
+TPU-era additions: pserver / master / coord service launchers).
+
+Subcommands:
+  paddle train --config=conf.py [--num_passes=N] [--save_dir=D] [--config_args=k=v,...]
+  paddle version
+  paddle merge_model --model_dir=DIR --out=OUT_DIR [--config_args=...]
+      (reference `paddle merge_model` fused config+params into one
+       binary for the C API; here: re-parse the v1 config, load the
+       pass params, export a save_inference_model directory that
+       capi/paddle_tpu_capi.h consumes)
+  paddle pserver [--port=P] [--checkpoint=PATH] [--checkpoint_sec=S]
+  paddle master [--port=P] [--lease_sec=S] [--failure_max=N]
+  paddle coord  [--port=P]
+"""
+
+import os
+import sys
+
+
+def _kv_args(argv):
+    out = {}
+    rest = []
+    for a in argv:
+        if a.startswith("--") and "=" in a:
+            k, v = a[2:].split("=", 1)
+            out[k] = v
+        else:
+            rest.append(a)
+    return out, rest
+
+
+def cmd_version(_):
+    import jax
+
+    import paddle_tpu
+
+    print(f"paddle_tpu {paddle_tpu.__version__}")
+    print(f"  jax {jax.__version__}; backend "
+          f"{jax.default_backend()} x{jax.device_count()}")
+    return 0
+
+
+def _cwd_importable():
+    # v1 config files import their own package tree relative to the
+    # invocation directory (reference: `paddle train` ran from the
+    # workdir with PYTHONPATH=.)
+    if os.getcwd() not in sys.path:
+        sys.path.insert(0, os.getcwd())
+
+
+def cmd_train(argv):
+    _cwd_importable()
+    from paddle_tpu.trainer.trainer import main as trainer_main
+
+    return trainer_main(argv)
+
+
+def cmd_merge_model(argv):
+    _cwd_importable()
+    args, _ = _kv_args(argv)
+    model_dir = args.get("model_dir")
+    out = args.get("out")
+    if not model_dir or not out:
+        print("usage: paddle merge_model --model_dir=DIR --out=OUT_DIR",
+              file=sys.stderr)
+        return 2
+    config = args.get("config") or os.path.join(model_dir, "trainer_config.py")
+    from paddle_tpu.trainer.trainer import Trainer
+    from paddle_tpu.trainer.config_parser import parse_config
+    import paddle_tpu as fluid
+
+    conf = parse_config(config, args.get("config_args", ""))
+    t = Trainer(conf)
+    t.load_parameters(model_dir)
+    t.export_inference_model(out)
+    print(f"merged model written to {out}")
+    return 0
+
+
+def _serve(make_server, argv, label):
+    import signal
+    import threading
+
+    args, _ = _kv_args(argv)
+    srv = make_server(args)
+    print(f"{label} listening on {srv.address}", flush=True)
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: done.set())
+    signal.signal(signal.SIGINT, lambda *a: done.set())
+    done.wait()
+    srv.stop()
+    return 0
+
+
+def cmd_pserver(argv):
+    from paddle_tpu.distributed import ParameterServer
+
+    return _serve(
+        lambda a: ParameterServer(port=int(a.get("port", 0)),
+                                  checkpoint_path=a.get("checkpoint", ""),
+                                  checkpoint_sec=int(a.get("checkpoint_sec", 0))),
+        argv, "pserver")
+
+
+def cmd_master(argv):
+    from paddle_tpu.distributed import MasterServer
+
+    return _serve(
+        lambda a: MasterServer(port=int(a.get("port", 0)),
+                               lease_sec=int(a.get("lease_sec", 10)),
+                               failure_max=int(a.get("failure_max", 3))),
+        argv, "master")
+
+
+def cmd_coord(argv):
+    from paddle_tpu.distributed import CoordServer
+
+    return _serve(lambda a: CoordServer(port=int(a.get("port", 0))),
+                  argv, "coord")
+
+
+COMMANDS = {
+    "train": cmd_train,
+    "version": cmd_version,
+    "merge_model": cmd_merge_model,
+    "pserver": cmd_pserver,
+    "master": cmd_master,
+    "coord": cmd_coord,
+}
+
+
+def main():
+    if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help"):
+        print(__doc__)
+        return 0 if len(sys.argv) >= 2 else 2
+    cmd = COMMANDS.get(sys.argv[1])
+    if cmd is None:
+        print(f"unknown command {sys.argv[1]!r}; "
+              f"one of {sorted(COMMANDS)}", file=sys.stderr)
+        return 2
+    return cmd(sys.argv[2:])
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -263,6 +263,16 @@ class _AttrDict(dict):
         super().clear()
         self._touch()
 
+    def popitem(self):
+        out = super().popitem()
+        self._touch()
+        return out
+
+    def __ior__(self, other):  # ``attrs |= {...}`` bypasses update()
+        super().update(other)
+        self._touch()
+        return self
+
     def __deepcopy__(self, memo):
         new = _AttrDict.__new__(_AttrDict)
         dict.__init__(new)

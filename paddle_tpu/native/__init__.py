@@ -9,9 +9,24 @@ import os
 import subprocess
 import threading
 
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_SRC_DIR = os.path.join(_REPO_ROOT, "native")
-_LIB_PATH = os.path.join(_SRC_DIR, "libpaddle_tpu_native.so")
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC_DIR = os.path.join(_PKG_DIR, "src")
+
+
+def _lib_path() -> str:
+    """Build target: next to the sources when writable (checkout /
+    editable install), else a per-user cache dir (system installs)."""
+    if os.access(_SRC_DIR, os.W_OK):
+        return os.path.join(_SRC_DIR, "libpaddle_tpu_native.so")
+    cache = os.path.join(
+        os.environ.get("XDG_CACHE_HOME",
+                       os.path.join(os.path.expanduser("~"), ".cache")),
+        "paddle_tpu")
+    os.makedirs(cache, exist_ok=True)
+    return os.path.join(cache, "libpaddle_tpu_native.so")
+
+
+_LIB_PATH = _lib_path()
 _SOURCES = ["recordio.cc", "data_loader.cc", "master_service.cc",
             "optimizer.cc", "pserver_service.cc", "coord_store.cc",
             "memory.cc"]

@@ -1,0 +1,153 @@
+// Sanitizer driver for the native runtime (SURVEY §5.2: TSAN/ASAN over
+// the hand-rolled threaded socket services — the cheap win the
+// reference never had).  Compiled twice by tests/test_sanitizers.py:
+// -fsanitize=address,undefined and -fsanitize=thread.  Exercises the
+// concurrency-bearing paths: service start/stop churn, multithreaded
+// buddy-allocator traffic, optimizer update/serialize, recordio
+// roundtrip via the prefetching loader.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* recordio_writer_open(const char*);
+int recordio_write(void*, const char*, uint32_t);
+void recordio_writer_close(void*);
+void* dl_open(const char*, int, int, int);
+long dl_next(void*, uint8_t*, uint32_t);
+void dl_close(void*);
+
+void* master_start(int, int, int);
+int master_port(void*);
+void master_stop(void*);
+void* pserver_start(int, const char*, int);
+int pserver_port(void*);
+void pserver_stop(void*);
+void* coord_start(int);
+int coord_port(void*);
+void coord_stop(void*);
+
+void* opt_create(const char*, float*, uint64_t);
+void opt_destroy(void*);
+int opt_update(void*, float*, uint64_t);
+uint64_t opt_serialize_size(void*);
+long opt_serialize(void*, uint8_t*, uint64_t);
+void* opt_deserialize(uint8_t*, uint64_t);
+int opt_get_weights(void*, float*, uint64_t);
+
+void* mem_pool_create(uint64_t, uint64_t);
+void mem_pool_destroy(void*);
+void* mem_alloc(void*, uint64_t);
+void mem_free(void*, void*);
+uint64_t mem_used(void*);
+}
+
+#define CHECK(cond)                                                    \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      std::fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,    \
+                   __LINE__, #cond);                                   \
+      return 1;                                                        \
+    }                                                                  \
+  } while (0)
+
+static int test_services_churn() {
+  // start/stop each threaded server repeatedly, overlapping lifetimes
+  for (int round = 0; round < 3; ++round) {
+    void* m = master_start(0, 1, 3);
+    void* p = pserver_start(0, "", 0);
+    void* c = coord_start(0);
+    CHECK(m && p && c);
+    CHECK(master_port(m) > 0);
+    CHECK(pserver_port(p) > 0);
+    CHECK(coord_port(c) > 0);
+    master_stop(m);
+    pserver_stop(p);
+    coord_stop(c);
+  }
+  return 0;
+}
+
+static int test_mem_pool_threads() {
+  void* pool = mem_pool_create(1 << 20, 16u << 20);
+  CHECK(pool);
+  std::atomic<int> fails{0};
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 4; ++t) {
+    ts.emplace_back([&, t] {
+      std::vector<void*> ptrs;
+      for (int i = 0; i < 200; ++i) {
+        void* q = mem_alloc(pool, 64 + 37 * ((i + t) % 100));
+        if (!q) {
+          fails.fetch_add(1);
+          continue;
+        }
+        std::memset(q, t, 64);
+        ptrs.push_back(q);
+        if (ptrs.size() > 8) {
+          mem_free(pool, ptrs.front());
+          ptrs.erase(ptrs.begin());
+        }
+      }
+      for (void* q : ptrs) mem_free(pool, q);
+    });
+  }
+  for (auto& t : ts) t.join();
+  CHECK(fails.load() == 0);
+  mem_pool_destroy(pool);
+  return 0;
+}
+
+static int test_optimizer_roundtrip() {
+  std::vector<float> w(128, 1.0f), g(128, 0.5f);
+  void* h = opt_create("type=sgd lr=0.1 momentum=0.9", w.data(), w.size());
+  CHECK(h);
+  for (int i = 0; i < 10; ++i) CHECK(opt_update(h, g.data(), g.size()) == 0);
+  uint64_t n = opt_serialize_size(h);
+  std::vector<uint8_t> buf(n);
+  CHECK(opt_serialize(h, buf.data(), n) == (long)n);
+  void* h2 = opt_deserialize(buf.data(), n);
+  CHECK(h2);
+  std::vector<float> w1(128), w2(128);
+  CHECK(opt_get_weights(h, w1.data(), 128) == 0);
+  CHECK(opt_get_weights(h2, w2.data(), 128) == 0);
+  CHECK(std::memcmp(w1.data(), w2.data(), 128 * sizeof(float)) == 0);
+  opt_destroy(h);
+  opt_destroy(h2);
+  return 0;
+}
+
+static int test_recordio_loader(const char* dir) {
+  std::string path = std::string(dir) + "/san.recordio";
+  void* w = recordio_writer_open(path.c_str());
+  CHECK(w);
+  for (int i = 0; i < 64; ++i) {
+    std::string rec(100 + i, 'a' + (i % 26));
+    CHECK(recordio_write(w, rec.data(), (uint32_t)rec.size()) == 0);
+  }
+  recordio_writer_close(w);
+  void* dl = dl_open(path.c_str(), 2, 8, 1 << 20);  // prefetch threads
+  CHECK(dl);
+  std::vector<uint8_t> buf(1 << 20);
+  int count = 0;
+  while (dl_next(dl, buf.data(), (uint32_t)buf.size()) >= 0) ++count;
+  CHECK(count == 64);
+  dl_close(dl);
+  return 0;
+}
+
+int main(int argc, char** argv) {
+  const char* dir = argc > 1 ? argv[1] : "/tmp";
+  int rc = 0;
+  rc |= test_services_churn();
+  rc |= test_mem_pool_threads();
+  rc |= test_optimizer_roundtrip();
+  rc |= test_recordio_loader(dir);
+  if (rc == 0) std::puts("native_sanitize: OK");
+  return rc;
+}
