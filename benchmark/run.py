@@ -27,6 +27,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
+# honor JAX_PLATFORMS before first backend use (the axon TPU plugin
+# otherwise overrides it and "CPU" runs silently hit the tunnel)
+if os.environ.get("JAX_PLATFORMS"):
+    try:
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    except Exception:
+        pass
+
 # model -> (default batch, baseline ms/batch, baseline source)
 BASELINES = {
     "alexnet":    (128, 334.0,   "K40m GPU, benchmark/README.md:33-37"),
@@ -57,7 +67,9 @@ def _train_step_fn(model_name, batch):
             "ids": rng.randint(0, 10000, (batch, T, 1)).astype(np.int64),
             "label": rng.randint(0, 2, (batch, 1)).astype(np.int64)}
     else:
-        image = {"smallnet": (3, 32, 32)}.get(model_name, (3, 224, 224))
+        smoke = os.environ.get("BENCH_SMOKE", "0") == "1"  # CI smoke: tiny
+        image = {"smallnet": (3, 16, 16) if smoke else (3, 32, 32)}.get(
+            model_name, (3, 224, 224))
         classes = {"smallnet": 10}.get(model_name, 1000)
         img = fluid.layers.data(name="img", shape=list(image),
                                 dtype="float32")
@@ -68,7 +80,7 @@ def _train_step_fn(model_name, batch):
             "vgg16": models.vgg16,
             "resnet50": models.resnet_imagenet,
             "smallnet": lambda x, class_dim: models.resnet_cifar10(
-                x, depth=20, class_dim=class_dim),
+                x, depth=8 if smoke else 20, class_dim=class_dim),
         }[model_name]
         pred = net(img, class_dim=classes)
         feed = lambda rng: {  # noqa: E731

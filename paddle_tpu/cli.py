@@ -19,6 +19,18 @@ Subcommands:
 import os
 import sys
 
+# Honor JAX_PLATFORMS before any backend use: the axon TPU plugin
+# registers itself as the default backend regardless of the env var, so
+# `JAX_PLATFORMS=cpu paddle train ...` would silently hit the TPU
+# tunnel (same dance as tests/conftest.py).
+if os.environ.get("JAX_PLATFORMS"):
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    except Exception:
+        pass
+
 
 def _kv_args(argv):
     out = {}

@@ -55,6 +55,8 @@ class CoordClient:
     def __init__(self, addr: str):
         host, port = addr.rsplit(":", 1)
         self._sock = socket.create_connection((host, int(port)))
+        self._sock.setsockopt(socket.IPPROTO_TCP,
+                              socket.TCP_NODELAY, 1)
         self._rfile = self._sock.makefile("rb")
         self._lock = threading.Lock()
         self._keepalive_stop = None

@@ -24,6 +24,7 @@ class MasterClient:
         if self._sock is not None:
             return
         s = socket.create_connection(self._addr, timeout=self._timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = s
         self._rfile = s.makefile("rb")
 

@@ -56,6 +56,9 @@ class _Conn:
     def __init__(self, addr: str):
         host, port = addr.rsplit(":", 1)
         self._sock = socket.create_connection((host, int(port)))
+        # request/response with small frames: Nagle + delayed ACK would
+        # add ~40-200ms per round trip
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._rfile = self._sock.makefile("rb")
         self._lock = threading.Lock()
 

@@ -33,6 +33,7 @@
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
+#include <netinet/tcp.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -301,6 +302,8 @@ void AcceptLoop(Store* st) {
       if (st->stop.load()) break;
       continue;
     }
+    int nd = 1;  // small req/resp frames: Nagle+delayed-ACK stalls
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &nd, sizeof(nd));
     std::lock_guard<std::mutex> l(st->conns_mu);
     st->live_fds.insert(fd);
     st->conns.emplace_back([st, fd] { ServeConn(st, fd); });

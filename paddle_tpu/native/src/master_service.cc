@@ -23,6 +23,7 @@
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
+#include <netinet/tcp.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -300,6 +301,8 @@ struct Master {
           if (stop) break;
           continue;
         }
+        int nd = 1;  // small req/resp frames: Nagle+delayed-ACK stalls
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &nd, sizeof(nd));
         conns.emplace_back([this, fd] { serve_conn(fd); });
       }
     });

@@ -37,6 +37,7 @@
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
+#include <netinet/tcp.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -374,6 +375,8 @@ void AcceptLoop(PServer* ps) {
       if (ps->stop.load()) break;
       continue;
     }
+    int nd = 1;  // small req/resp frames: Nagle+delayed-ACK stalls
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &nd, sizeof(nd));
     std::lock_guard<std::mutex> l(ps->conns_mu);
     ps->live_fds.insert(fd);
     ps->conns.emplace_back([ps, fd] { ServeConn(ps, fd); });

@@ -6,9 +6,22 @@ import os
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_backend_optimization_level" not in _flags:
+    # tests are compile-bound (every test builds fresh XLA programs);
+    # opt level 0 halves compile time with identical numerics — measured
+    # 71s -> 32s on the GoogLeNet train-step compile
+    _flags = (_flags + " --xla_backend_optimization_level=0").strip()
+os.environ["XLA_FLAGS"] = _flags
+# persistent compile cache: warm reruns skip XLA compilation entirely
+# (keyed by HLO hash, so correctness is unaffected; measured 26s -> 9s
+# on the GoogLeNet test).  Opt out with PADDLE_TPU_TEST_NO_XLA_CACHE=1.
+if os.environ.get("PADDLE_TPU_TEST_NO_XLA_CACHE", "0") != "1":
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache",
+                     "paddle_tpu_test_xla"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
 
 import jax  # noqa: E402
 

@@ -257,14 +257,14 @@ def test_googlenet_forward_and_train_step(rng):
     resolution (reference: benchmark/paddle/image/googlenet.py)."""
     from paddle_tpu.models import googlenet
 
-    img = fluid.layers.data(name="img", shape=[3, 224, 224], dtype="float32")
+    img = fluid.layers.data(name="img", shape=[3, 112, 112], dtype="float32")
     label = fluid.layers.data(name="label", shape=[1], dtype="int64")
     pred = googlenet(img, class_dim=10)
     loss = fluid.layers.mean(fluid.layers.cross_entropy(input=pred, label=label))
     fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(fluid.default_startup_program())
-    xs = rng.randn(2, 3, 224, 224).astype("float32")
+    xs = rng.randn(2, 3, 112, 112).astype("float32")
     ys = rng.randint(0, 10, (2, 1)).astype("int64")
     (l,) = exe.run(feed={"img": xs, "label": ys}, fetch_list=[loss])
     assert np.isfinite(float(l))

@@ -19,7 +19,7 @@ _DRIVER = os.path.join(_HERE, "native_sanitize.cc")
 
 def _build_and_run(tmp_path, san_flag, env_extra):
     exe = str(tmp_path / f"native_san_{san_flag.split('=')[1].split(',')[0]}")
-    cmd = ["g++", "-std=c++17", "-g", "-O1", "-pthread", san_flag,
+    cmd = ["g++", "-std=c++17", "-g", "-O0", "-pthread", san_flag,
            "-fno-omit-frame-pointer", "-o", exe] + _SOURCES + [_DRIVER]
     build = subprocess.run(cmd, capture_output=True, text=True)
     assert build.returncode == 0, build.stderr[-3000:]
