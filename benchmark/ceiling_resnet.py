@@ -29,7 +29,18 @@ DN = (("NHWC", "HWIO", "NHWC") if LAYOUT == "NHWC" else ("NCHW", "OIHW", "NCHW")
 C_AXIS = 3 if LAYOUT == "NHWC" else 1
 
 
+DOT1X1 = os.environ.get("CEIL_DOT1X1", "0") == "1"
+
+
 def conv(x, w, stride, pad):
+    if (DOT1X1 and LAYOUT == "NHWC" and w.shape[0] == 1 and w.shape[1] == 1
+            and pad == 0):
+        # 1x1 conv as an explicit matmul: XLA's dot emitter sustains a
+        # higher fraction of the MXU roofline than the conv emitter at
+        # these shapes (measured).  stride-2 = subsample then dot.
+        if stride != 1:
+            x = x[:, ::stride, ::stride, :]
+        return jnp.dot(x, w[0, 0])
     return lax.conv_general_dilated(
         x, w, window_strides=(stride, stride), padding=[(pad, pad), (pad, pad)],
         dimension_numbers=DN)

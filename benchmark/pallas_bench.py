@@ -173,6 +173,103 @@ def bench_lstm():
             timeit(chain_g(lstm_seq), xp, w, bias, h0, c0) * 1e3)
 
 
+def bench_batch_norm():
+    """ResNet-50 BS=256 BN shapes, channel-minor (R=N*H*W, C) view."""
+    from paddle_tpu.pallas.batch_norm import batch_norm_train, _bn_fwd_impl
+    from jax import lax
+
+    eps = 1e-5
+
+    def xla_bn(x, g, b):
+        m = jnp.mean(x, 0, dtype=jnp.float32)
+        v = jnp.mean(jnp.square(x.astype(jnp.float32)), 0) - m * m
+        inv = lax.rsqrt(v + eps)
+        a = g.astype(jnp.float32) * inv
+        bb = b.astype(jnp.float32) - m * a
+        return (x * a.astype(x.dtype)[None] + bb.astype(x.dtype)[None],
+                m, v)
+
+    for R, C in ((256 * 56 * 56, 256), (256 * 28 * 28, 512),
+                 (256 * 14 * 14, 1024)):
+        x = jax.random.normal(jax.random.key(0), (R, C), jnp.bfloat16)
+        g = jnp.ones((C,), jnp.float32)
+        b = jnp.zeros((C,), jnp.float32)
+
+        def chain_f(bn):
+            def run(x, g, b):
+                for _ in range(CHAIN):
+                    y, m, v = bn(x, g, b)
+                    x = y + jnp.asarray(1e-6, y.dtype)
+                return x
+            return jax.jit(run)
+
+        row(f"batch_norm_fwd_R{R}_C{C}",
+            timeit(chain_f(xla_bn), x, g, b) * 1e3,
+            timeit(chain_f(lambda x, g, b: _bn_fwd_impl(x, g, b, eps)),
+                   x, g, b) * 1e3)
+
+        def chain_t(bn):
+            def loss(x, g, b):
+                acc = x
+                for _ in range(CHAIN):
+                    y, m, v = bn(acc, g, b)
+                    acc = y + jnp.asarray(1e-6, y.dtype)
+                return jnp.sum(acc.astype(jnp.float32))
+
+            def run(x, g, b):
+                return jax.grad(loss)(x, g, b)
+            return jax.jit(run)
+
+        row(f"batch_norm_train_R{R}_C{C}",
+            timeit(chain_t(xla_bn), x, g, b) * 1e3,
+            timeit(chain_t(batch_norm_train), x, g, b) * 1e3)
+
+
+def bench_flash_attention():
+    """Transformer-flagship shapes (B=8 H=16 D=128) + long-context."""
+    from paddle_tpu.pallas.flash_attention import flash_attention
+    from paddle_tpu.parallel.ring_attention import local_attention
+
+    for BH, S, D in ((128, 1024, 128), (128, 2048, 128), (16, 8192, 128)):
+        q, k, v = (jax.random.normal(jax.random.key(i), (BH, S, D),
+                                     jnp.bfloat16) for i in range(3))
+
+        def jnp_attn(q, k, v):
+            o = local_attention(q[:, None], k[:, None], v[:, None],
+                                causal=True)
+            return o[:, 0]
+
+        def fl_attn(q, k, v):
+            return flash_attention(q, k, v, True)
+
+        def chain_f(f):
+            def run(q, k, v):
+                for _ in range(CHAIN):
+                    o = f(q, k, v)
+                    q = o + jnp.asarray(1e-3, o.dtype)
+                return o
+            return jax.jit(run)
+
+        row(f"flash_attn_fwd_BH{BH}_S{S}_D{D}",
+            timeit(chain_f(jnp_attn), q, k, v) * 1e3,
+            timeit(chain_f(fl_attn), q, k, v) * 1e3)
+
+        def chain_t(f):
+            def loss(q, k, v):
+                acc = q
+                for _ in range(CHAIN):
+                    acc = f(acc, k, v) + jnp.asarray(1e-3, q.dtype)
+                return jnp.sum(acc.astype(jnp.float32))
+
+            def run(q, k, v):
+                return jax.grad(loss)(q, k, v)
+            return jax.jit(run)
+
+        row(f"flash_attn_train_BH{BH}_S{S}_D{D}",
+            timeit(chain_t(jnp_attn), q, k, v) * 1e3,
+            timeit(chain_t(fl_attn), q, k, v) * 1e3)
+
+
 if __name__ == "__main__":
     import sys
 
@@ -185,3 +282,7 @@ if __name__ == "__main__":
         bench_gather()
     if which in ("all", "lstm"):
         bench_lstm()
+    if which in ("all", "batch_norm"):
+        bench_batch_norm()
+    if which in ("all", "flash"):
+        bench_flash_attention()

@@ -68,5 +68,15 @@ def _sdp_attention(ctx):
             batch_axis=getattr(s, "dp_axis", None),
             head_axis=getattr(s, "tp_axis", None))
     else:
-        out = local_attention(qt, kt, vt, causal=causal)
+        from paddle_tpu import pallas as pk
+
+        B, H, S, D = qt.shape
+        Sk = kt.shape[2]
+        if pk.use_flash_attention(B * H, S, Sk, D):
+            out = pk.pallas_flash_attention(
+                qt.reshape(B * H, S, D), kt.reshape(B * H, Sk, D),
+                vt.reshape(B * H, Sk, D), causal, None,
+                pk.interpret_mode()).reshape(B, H, S, D)
+        else:
+            out = local_attention(qt, kt, vt, causal=causal)
     ctx.set_output("Out", rewrap(ctx.input("Q"), out.transpose(0, 2, 1, 3)))

@@ -87,6 +87,32 @@ def use_softmax(rows: int, cols: int) -> bool:
         return True
     return _auto_ok() and cols <= 256  # measured: XLA wins at 512
 
+def use_flash_attention(bh: int, s_q: int, s_k: int, d: int) -> bool:
+    """Blocked online-softmax attention.  Measured (PALLAS_BENCH.md):
+    beats the jnp softmax(QK^T)V lowering at S>=1024 where the S x S
+    score tensor stops fitting cache-friendly fusions; below that XLA's
+    fused unblocked attention wins on kernel-count."""
+    from paddle_tpu.pallas import flash_attention as _f
+
+    if _STATE["mode"] == "off" or not _f.fits(1, bh, s_q, d) or s_q != s_k:
+        return False
+    if _STATE["mode"] == "on":
+        return True
+    return _auto_ok() and s_q >= 1024
+
+
+def use_batch_norm(rows: int, cols: int) -> bool:
+    """Fused BN stats+normalize / BN-grad kernels.  Measured
+    (PALLAS_BENCH.md): XLA's BN lowering runs at a higher fraction of
+    HBM bandwidth at ResNet shapes (and fuses the statistics into the
+    producing conv's epilogue inside real models), so the kernels are
+    never auto-dispatched — they remain as tested reference kernels
+    and the building block for fused epilogue variants."""
+    from paddle_tpu.pallas import batch_norm as _b
+
+    return _STATE["mode"] == "on" and _b.fits(rows, cols)
+
+
 def use_matmul() -> bool:
     return _STATE["mode"] == "on"  # measured 0.6-0.9x vs XLA: never auto
 
@@ -99,3 +125,7 @@ from paddle_tpu.pallas.matmul import matmul as pallas_matmul  # noqa: E402
 from paddle_tpu.pallas.softmax import softmax as pallas_softmax  # noqa: E402
 from paddle_tpu.pallas.embedding import gather_rows as pallas_gather_rows  # noqa: E402
 from paddle_tpu.pallas.lstm import lstm_seq as pallas_lstm_seq  # noqa: E402
+from paddle_tpu.pallas.flash_attention import (  # noqa: E402
+    flash_attention as pallas_flash_attention)
+from paddle_tpu.pallas.batch_norm import (  # noqa: E402
+    batch_norm_train as pallas_batch_norm_train)

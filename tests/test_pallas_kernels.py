@@ -160,3 +160,133 @@ def test_lstm_op_pallas_path_matches_scan(rng):
         pk.enable("auto", interpret=False)
     np.testing.assert_allclose(h_pal, h_scan, atol=1e-6)
     np.testing.assert_allclose(c_pal, c_scan, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# batch_norm kernels (pallas/batch_norm.py)
+# ---------------------------------------------------------------------------
+
+
+def _bn_ref(x, g, b, eps=1e-5):
+    m = x.mean(0)
+    v = (x * x).mean(0) - m * m
+    return (x - m) / np.sqrt(v + eps) * g + b, m, v
+
+
+def test_batch_norm_kernel_fwd(rng):
+    from paddle_tpu.pallas.batch_norm import batch_norm_train
+
+    x = rng.randn(1024, 96).astype("float32")
+    g = (rng.rand(96) + 0.5).astype("float32")
+    b = rng.randn(96).astype("float32")
+    y, m, v = batch_norm_train(jnp.asarray(x), jnp.asarray(g),
+                               jnp.asarray(b), 1e-5, True)
+    want_y, want_m, want_v = _bn_ref(x, g, b)
+    np.testing.assert_allclose(np.asarray(y), want_y, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(m), want_m, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(v), want_v, atol=1e-5)
+
+
+def test_batch_norm_kernel_grads_match_xla(rng):
+    from paddle_tpu.pallas.batch_norm import batch_norm_train
+
+    x = jnp.asarray(rng.randn(512, 64).astype("float32"))
+    g = jnp.asarray((rng.rand(64) + 0.5).astype("float32"))
+    b = jnp.asarray(rng.randn(64).astype("float32"))
+
+    def loss_k(x, g, b):
+        return jnp.sum(jnp.sin(batch_norm_train(x, g, b, 1e-5, True)[0]))
+
+    def loss_r(x, g, b):
+        m = jnp.mean(x, 0)
+        v = jnp.mean(x * x, 0) - m * m
+        return jnp.sum(jnp.sin((x - m) / jnp.sqrt(v + 1e-5) * g + b))
+
+    got = jax.grad(loss_k, (0, 1, 2))(x, g, b)
+    want = jax.grad(loss_r, (0, 1, 2))(x, g, b)
+    for a, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(w),
+                                   atol=5e-4, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# flash attention kernels (pallas/flash_attention.py)
+# ---------------------------------------------------------------------------
+
+
+def _attn_ref(q, k, v, causal):
+    S, Sk = q.shape[1], k.shape[1]
+    s = jnp.einsum("bqd,bkd->bqk", q, k) * (q.shape[-1] ** -0.5)
+    if causal:
+        s = jnp.where(jnp.tril(jnp.ones((S, Sk), bool))[None], s, -jnp.inf)
+    return jnp.einsum("bqk,bkd->bqd", jax.nn.softmax(s, -1), v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_fwd(rng, causal):
+    from paddle_tpu.pallas.flash_attention import flash_attention
+
+    q, k, v = (jnp.asarray(rng.randn(2, 256, 64).astype("float32"))
+               for _ in range(3))
+    with jax.default_matmul_precision("highest"):
+        out = flash_attention(q, k, v, causal, None, True)
+        ref = _attn_ref(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_grads(rng, causal):
+    from paddle_tpu.pallas.flash_attention import flash_attention
+
+    q, k, v = (jnp.asarray(rng.randn(2, 256, 64).astype("float32"))
+               for _ in range(3))
+
+    with jax.default_matmul_precision("highest"):
+        def loss_k(q, k, v):
+            return jnp.sum(jnp.cos(flash_attention(q, k, v, causal, None,
+                                                   True)))
+
+        def loss_r(q, k, v):
+            return jnp.sum(jnp.cos(_attn_ref(q, k, v, causal)))
+
+        got = jax.grad(loss_k, (0, 1, 2))(q, k, v)
+        want = jax.grad(loss_r, (0, 1, 2))(q, k, v)
+    for a, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(w),
+                                   atol=2e-3, rtol=1e-3)
+
+
+def test_flash_attention_via_attention_op(rng):
+    """scaled_dot_product_attention lowers through the flash kernel with
+    the flag on (interpret) and matches the flag-off jnp path."""
+    def run():
+        fluid.framework.reset_default_programs()
+        from paddle_tpu import executor as em
+
+        em._global_scope = em.Scope()
+        em._scope_stack = [em._global_scope]
+        x = fluid.layers.data(name="x", shape=[256, 64], dtype="float32")
+        from paddle_tpu.layer_helper import LayerHelper
+
+        h = LayerHelper("fa_test")
+        out = h.create_tmp_variable("float32", x.shape)
+        h.append_op(type="scaled_dot_product_attention",
+                    inputs={"Q": [x], "K": [x], "V": [x]},
+                    outputs={"Out": [out]}, attrs={"causal": True})
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        feed = {"x": rng.randn(2, 256, 1, 64).astype("float32")
+                .reshape(2, 256, 64)[:, :, None, :].reshape(2, 256, 1, 64)}
+        (o,) = exe.run(feed=feed, fetch_list=[out])
+        return o
+
+    rng_state = rng.get_state()
+    pk.enable(False)
+    want = run()
+    rng.set_state(rng_state)
+    pk.enable(True, interpret=True)
+    try:
+        got = run()
+    finally:
+        pk.enable("auto", interpret=False)
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=1e-3)
