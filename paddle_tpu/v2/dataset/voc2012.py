@@ -1,19 +1,64 @@
 """PASCAL VOC2012 segmentation (reference:
-python/paddle/v2/dataset/voc2012.py).  Records: (float32[3,H,W] image in
-[0,1], int32[H,W] label mask with values in [0,21) or 255=ignore).
+python/paddle/v2/dataset/voc2012.py).
 
-No egress: deterministic synthetic scenes — a background plus a few
-axis-aligned object rectangles whose class paints both the image hue
-and the mask, preserving the image/mask alignment contract real
-consumers rely on."""
+Real path: the VOCtrainval tarball's ImageSets/Segmentation lists +
+JPEGImages/SegmentationClass pairs decoded with PIL (reference
+voc2012.py:42-85; split naming follows it: train()='trainval',
+test()='train', val()='val').  Records: (float32[3,H,W] image in
+[0,1], int32[H,W] label mask in [0,21) with 255=ignore) — the
+reference yields raw uint8 arrays; this module normalizes to the model
+input contract its consumers use.
+
+Offline fallback: synthetic scenes of axis-aligned object rectangles
+painting image hue and mask consistently.
+"""
+
+import io
+import tarfile
 
 import numpy as np
 
 from paddle_tpu.v2.dataset import common
 
+__all__ = ["train", "test", "val"]
+
+VOC_URL = ("http://host.robots.ox.ac.uk/pascal/VOC/voc2012/"
+           "VOCtrainval_11-May-2012.tar")
+VOC_MD5 = "6cd6e144f989b92b3379bac3b3de84fd"
+SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+
 CLASS_NUM = 21  # 20 objects + background
 IGNORE_LABEL = 255
 _H = _W = 64
+
+
+def _real_reader(sub_name):
+    tar_path = common.maybe_download(VOC_URL, "voc2012", VOC_MD5)
+    if tar_path is None:
+        return None
+    from PIL import Image
+
+    def reader():
+        with tarfile.open(tar_path) as tf:
+            members = {m.name: m for m in tf.getmembers() if m.isfile()}
+            sets = tf.extractfile(members[SET_FILE.format(sub_name)])
+            for line in sets:
+                name = line.decode("utf-8").strip()
+                if not name:
+                    continue
+                data = tf.extractfile(members[DATA_FILE.format(name)]).read()
+                label = tf.extractfile(
+                    members[LABEL_FILE.format(name)]).read()
+                img = Image.open(io.BytesIO(data)).convert("RGB")
+                msk = Image.open(io.BytesIO(label))
+                img_arr = (np.asarray(img, np.float32)
+                           .transpose(2, 0, 1) / 255.0)
+                msk_arr = np.asarray(msk, np.int32)
+                yield img_arr, msk_arr
+
+    return reader
 
 
 def _synth(split, n):
@@ -39,12 +84,13 @@ def _synth(split, n):
 
 
 def train():
-    return _synth("train", 1464)
+    """'trainval' list, mirroring the reference's train() (voc2012.py:67)."""
+    return _real_reader("trainval") or _synth("train", 1464)
 
 
 def test():
-    return _synth("test", 512)
+    return _real_reader("train") or _synth("test", 512)
 
 
 def val():
-    return _synth("val", 512)
+    return _real_reader("val") or _synth("val", 512)
