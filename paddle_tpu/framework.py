@@ -300,7 +300,7 @@ class Operator:
         self.outputs: Dict[str, List[str]] = {
             k: _as_name_list(v) for k, v in (outputs or {}).items()
         }
-        self.attrs: Dict[str, Any] = _AttrDict(self, attrs or {})
+        self._attrs: Dict[str, Any] = _AttrDict(self, attrs or {})
         # Run registry-side checks/infer-shape at append time, like the
         # reference's compile-time InferShape (framework/op_desc.cc).
         from paddle_tpu import registry
@@ -325,6 +325,20 @@ class Operator:
     @property
     def output_arg_names(self) -> List[str]:
         return [n for ns in self.outputs.values() for n in ns]
+
+    @property
+    def attrs(self) -> Dict[str, Any]:
+        return self._attrs
+
+    @attrs.setter
+    def attrs(self, mapping):
+        # wholesale rebinds (op.attrs = {...}) must stay version-tracked,
+        # or the executor compile cache silently reuses stale executables
+        if isinstance(mapping, _AttrDict) and mapping._op is self:
+            self._attrs = mapping
+        else:
+            self._attrs = _AttrDict(self, dict(mapping or {}))
+        self._attrs._touch()
 
     def attr(self, name: str, default=None):
         return self.attrs.get(name, default)

@@ -291,6 +291,14 @@ def _cross_entropy_over_beam(ctx):
     Golds: list of (B, 1) int gold indices."""
     scores = [unwrap(v) for v in ctx.inputs("Scores")]
     golds = [unwrap(v) for v in ctx.inputs("Golds")]
+    if len(scores) > 1:
+        import warnings
+
+        warnings.warn(
+            "cross_entropy_over_beam: multi-step beams are normalized "
+            "per expansion step here; the reference CrossEntropyOverBeam "
+            "normalizes once over all expanded paths, so the training "
+            "objective differs for multi-step inputs", stacklevel=2)
     B = scores[0].shape[0]
     total = jnp.zeros((B,), jnp.float32)
     for s, g in zip(scores, golds):

@@ -445,3 +445,23 @@ def _block_expand(ctx):
     B, CKK, OH, OW = patches.shape
     ctx.set_output("Out",
                    jnp.moveaxis(patches.reshape(B, CKK, OH * OW), 1, 2))
+
+
+@register_op("scale_sub_region_mask", inputs=("X", "Indices"))
+def _scale_sub_region_mask(ctx):
+    """Scale the per-sample (C, H, W) subregion given by Indices
+    (B, 6) = [c0, c1, h0, h1, w0, w1], 1-based inclusive (reference:
+    gserver/layers/ScaleSubRegionLayer.cpp) — lowered as an iota mask
+    so the region stays dynamic per sample with static shapes."""
+    x = unwrap(ctx.input("X"))
+    idx = unwrap(ctx.input("Indices")).astype(jnp.int32)
+    value = ctx.attr("value", 1.0)
+    B, C, H, W = x.shape
+    c = lax.broadcasted_iota(jnp.int32, (B, C, H, W), 1)
+    h = lax.broadcasted_iota(jnp.int32, (B, C, H, W), 2)
+    w = lax.broadcasted_iota(jnp.int32, (B, C, H, W), 3)
+    r = idx.reshape(B, 6, 1, 1, 1)
+    mask = ((c >= r[:, 0] - 1) & (c <= r[:, 1] - 1) &
+            (h >= r[:, 2] - 1) & (h <= r[:, 3] - 1) &
+            (w >= r[:, 4] - 1) & (w <= r[:, 5] - 1))
+    ctx.set_output("Out", jnp.where(mask, x * value, x))

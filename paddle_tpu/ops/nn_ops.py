@@ -260,14 +260,34 @@ def _pad(ctx):
 
 @register_op("crop", inputs=("X", "Y"))
 def _crop(ctx):
+    """Crop X to a target shape from ``axis`` onward (reference:
+    operators/crop_op.cc + CropLayer axis semantics: dims before
+    ``axis`` are kept whole; offsets default to 0)."""
     x = unwrap(ctx.input("X"))
-    offsets = ctx.attr("offsets")
+    axis = ctx.attr("axis", 0)
+    offsets = list(ctx.attr("offsets") or [])
     if ctx.has_input("Y"):
-        shape = unwrap(ctx.input("Y")).shape
+        tgt = list(unwrap(ctx.input("Y")).shape)
+        if len(tgt) == x.ndim:
+            shape = tgt[axis:]
+        else:
+            shape = tgt
     else:
-        shape = ctx.attr("shape")
-    sl = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
-    ctx.set_output("Out", x[sl])
+        shape = list(ctx.attr("shape"))
+        if len(shape) == x.ndim:
+            axis, shape = 0, shape
+    if len(offsets) == x.ndim:
+        axis = 0
+    if not offsets:
+        offsets = [0] * len(shape)
+    if len(offsets) != len(shape):
+        raise ValueError(
+            f"crop: offsets rank {len(offsets)} != target rank "
+            f"{len(shape)} (axis={axis}); silent truncation would crop "
+            "the wrong dimensions")
+    sl = [slice(None)] * axis + [
+        slice(o, o + s) for o, s in zip(offsets, shape)]
+    ctx.set_output("Out", x[tuple(sl)])
 
 
 @register_op("conv3d_transpose", inputs=("Input", "Filter"),
@@ -288,3 +308,16 @@ def _conv3d_transpose(ctx):
         transpose_kernel=True,
     ).astype(x.dtype)
     ctx.set_output("Output", out)
+
+
+@register_op("bilinear_interp", inputs=("X",))
+def _bilinear_interp(ctx):
+    """Bilinear resize over NCHW spatial dims (reference:
+    operators/bilinear_interp_op.cc / BilinearInterpLayer)."""
+    x = unwrap(ctx.input("X"))
+    oh = ctx.attr("out_h")
+    ow = ctx.attr("out_w")
+    n, c = x.shape[0], x.shape[1]
+    out = jax.image.resize(x.astype(jnp.float32), (n, c, oh, ow),
+                           method="bilinear").astype(x.dtype)
+    ctx.set_output("Out", out)

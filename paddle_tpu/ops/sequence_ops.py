@@ -229,6 +229,22 @@ def _lstm(ctx):
         data = x_in.data                       # (N, 4H)
         N = data.shape[0]
         S = off.shape[0] - 1
+        # the pad-out below materializes (S, N, 4H): quadratic in the
+        # sequence count because N (total rows) is the only static
+        # Tmax bound when offsets are traced.  Guard against the
+        # silent OOM/perf cliff instead of allocating tens of GB.
+        import os as _os
+
+        limit = int(_os.environ.get("PADDLE_TPU_LOD_LSTM_PAD_LIMIT",
+                                    1 << 30))
+        if S * N * data.shape[-1] > limit:
+            raise ValueError(
+                f"LoD lstm: padding {S} sequences of {N} packed rows "
+                f"would materialize a {S}x{N}x{data.shape[-1]} tensor "
+                f"({S * N * data.shape[-1] * 4 / 1e9:.1f} GB f32). "
+                "Pre-pad the input to (batch, Tmax, 4H) (the fast "
+                "path), split the batch, or raise "
+                "PADDLE_TPU_LOD_LSTM_PAD_LIMIT.")
         t_idx = jnp.arange(N, dtype=jnp.int32)
         lens = off[1:] - off[:-1]
         lod_reverse = bool(ctx.attr("is_reverse", False))
@@ -453,3 +469,43 @@ def _padded_sequence_slice(ctx):
     vmask = valid.reshape(valid.shape + (1,) * (x.ndim - 2))
     ctx.set_output("Out", jnp.where(vmask, gathered, 0))
     ctx.set_output("OutLength", new_len)
+
+
+@register_op("sub_nested_seq",
+             inputs=("X", "Lengths", "SubLengths", "Selected"),
+             outputs=("Out", "OutLengths", "OutSubLengths"))
+def _sub_nested_seq(ctx):
+    """Select sub-sequences of a 2-level nested sequence by per-sample
+    indices (reference: operators/../gserver SubNestedSequenceLayer —
+    the beam-search training selection).  X: (B, S, T, d) padded;
+    Selected: (B, k) indices into the S axis."""
+    x = unwrap(ctx.input("X"))
+    lengths = unwrap(ctx.input("Lengths"))
+    sub_lengths = unwrap(ctx.input("SubLengths"))
+    sel = unwrap(ctx.input("Selected")).astype(jnp.int32)
+    B, k = sel.shape
+    sel_c = jnp.clip(sel, 0, x.shape[1] - 1)
+    out = jnp.take_along_axis(
+        x, sel_c.reshape(B, k, *([1] * (x.ndim - 2))), axis=1)
+    out_sub = jnp.take_along_axis(sub_lengths, sel_c, axis=1)
+    # rows whose index is out of range contribute empty seqs; negative
+    # ids are the reference's pad/terminator convention (-1 = no pick)
+    valid = (sel >= 0) & (sel < lengths[:, None])
+    out_sub = jnp.where(valid, out_sub, 0).astype(jnp.int32)
+    ctx.set_output("Out", out)
+    ctx.set_output("OutLengths",
+                   jnp.sum(valid, axis=1).astype(jnp.int32))
+    ctx.set_output("OutSubLengths", out_sub)
+
+
+@register_op("mask_padded_scores", inputs=("X", "Length"))
+def _mask_padded_scores(ctx):
+    """Set scores past each sequence's length to -inf so top-k/argmax
+    never select padding steps (KmaxSeqScoreLayer's per-sequence
+    semantics over the padded dense layout)."""
+    x = unwrap(ctx.input("X"))                   # (B, T)
+    lens = unwrap(ctx.input("Length")).reshape(-1).astype(jnp.int32)
+    mask = jnp.arange(x.shape[1])[None, :] < lens[:, None]
+    # large-but-finite (not -inf): keeps downstream reductions and
+    # central-difference grad checks NaN-free
+    ctx.set_output("Out", jnp.where(mask, x, jnp.asarray(-1e30, x.dtype)))

@@ -47,6 +47,13 @@ def gpipe(layer_fn: Callable, stacked_params, x, *, mesh, pp_axis: str,
     stacked_params: pytree of (L, ...) arrays, L = total layers.
     x: (B, S, ...) global activations; microbatched on dim 0.
 
+    Microbatch membership contract: rows are assigned round-robin (row
+    r lands in microbatch ``r % n_microbatch``), not in contiguous
+    chunks as canonical GPipe slices them; the inverse mapping restores
+    row order on output.  Per-row layer_fns are unaffected, but any
+    batch-coupled computation inside layer_fn (e.g. batch statistics)
+    sees different groupings than a contiguous split would produce.
+
     n_microbatch must divide the batch; the pp axis size must divide L.
     """
     B = x.shape[0]

@@ -38,4 +38,7 @@ class ParamAttr:
 
         if isinstance(arg, Initializer):
             return ParamAttr(initializer=arg)
+        if arg is True:
+            # v1 bias_attr=True means "use a default bias"
+            return ParamAttr()
         raise TypeError(f"cannot convert {arg!r} to ParamAttr")

@@ -19,3 +19,6 @@ from paddle_tpu.trainer_config_helpers.networks import *  # noqa: F401,F403
 from paddle_tpu.trainer_config_helpers.optimizers import *  # noqa: F401,F403
 from paddle_tpu.trainer_config_helpers.data_sources import *  # noqa: F401,F403
 from paddle_tpu.trainer_config_helpers.evaluators import *  # noqa: F401,F403
+
+# operator overloads for LayerOutput + the layer_math namespace
+from paddle_tpu.trainer_config_helpers import layer_math  # noqa: E402,F401

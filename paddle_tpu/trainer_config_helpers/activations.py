@@ -10,7 +10,8 @@ __all__ = [
     "ReluActivation", "SigmoidActivation", "TanhActivation",
     "SoftmaxActivation", "ExpActivation", "LogActivation",
     "SquareActivation", "SoftReluActivation", "BReluActivation",
-    "LeakyReluActivation", "STanhActivation",
+    "LeakyReluActivation", "STanhActivation", "AbsActivation",
+    "SqrtActivation", "ReciprocalActivation",
 ]
 
 BaseActivation = _a.BaseActivation
@@ -27,3 +28,6 @@ SoftReluActivation = _a.SoftRelu
 BReluActivation = _a.BRelu
 LeakyReluActivation = _a.LeakyRelu
 STanhActivation = _a.STanh
+AbsActivation = _a.Abs
+SqrtActivation = _a.Sqrt
+ReciprocalActivation = _a.Reciprocal

@@ -49,3 +49,8 @@ class ExtraLayerAttribute:
 
 
 ExtraAttr = ExtraLayerAttribute
+
+# the v1 surface spells ParamAttr with the v1 kwargs (initial_mean,
+# initial_std, initial_max/min...) — reference attrs.py exports
+# ParameterAttribute under both names
+ParamAttr = ParameterAttribute

@@ -1,0 +1,104 @@
+"""v1 layer math: unary math helpers + operator overloads on
+LayerOutput (reference: python/paddle/trainer_config_helpers/
+layer_math.py — importing this module enables ``x + y``, ``2 * x`` etc.
+on layers; unary ops are one-projection mixed layers with the math
+activation).
+"""
+
+from paddle_tpu.trainer_config_helpers import activations as act
+from paddle_tpu.trainer_config_helpers.layers import (
+    LayerOutput, identity_projection, mixed_layer, scaling_layer,
+    slope_intercept_layer)
+
+__all__ = []
+
+
+def _register_unary(op_name, activation):
+    def op(input, name=None):
+        with mixed_layer(size=input.size, name=name,
+                         act=activation) as m:
+            m += identity_projection(input=input)
+        return m._lo
+
+    op.__name__ = op_name
+    globals()[op_name] = op
+    __all__.append(op_name)
+
+
+_register_unary("exp", act.ExpActivation())
+_register_unary("log", act.LogActivation())
+_register_unary("abs", act.AbsActivation())
+_register_unary("sigmoid", act.SigmoidActivation())
+_register_unary("tanh", act.TanhActivation())
+_register_unary("square", act.SquareActivation())
+_register_unary("relu", act.ReluActivation())
+_register_unary("sqrt", act.SqrtActivation())
+_register_unary("reciprocal", act.ReciprocalActivation())
+
+
+def add(layeroutput, other):
+    if isinstance(other, (int, float)):
+        return slope_intercept_layer(input=layeroutput, intercept=other)
+    if not isinstance(other, LayerOutput):
+        raise TypeError("LayerOutput can only be added with another "
+                        "LayerOutput or a number")
+    if layeroutput.size == other.size:
+        with mixed_layer(size=layeroutput.size) as m:
+            m += identity_projection(input=layeroutput)
+            m += identity_projection(input=other)
+        return m._lo
+    if other.size != 1 and layeroutput.size != 1:
+        raise ValueError(
+            "two LayerOutputs can be added only with equal sizes or one "
+            f"size-1 operand; got {layeroutput.size} and {other.size}")
+    if layeroutput.size == 1:
+        layeroutput, other = other, layeroutput
+    # broadcast the size-1 operand: x + w = x + w*ones, via two steps
+    # (reference layer_math.add does the same expand through repeat)
+    from paddle_tpu.trainer_config_helpers.layers import repeat_layer
+
+    rep = repeat_layer(input=other, num_repeats=layeroutput.size)
+    with mixed_layer(size=layeroutput.size) as m:
+        m += identity_projection(input=layeroutput)
+        m += identity_projection(input=rep)
+    return m._lo
+
+
+LayerOutput.__radd__ = add
+LayerOutput.__add__ = add
+
+
+def sub(layeroutput, other):
+    if isinstance(other, (int, float)):
+        return slope_intercept_layer(input=layeroutput, intercept=-other)
+    neg = slope_intercept_layer(input=other, slope=-1.0)
+    return add(layeroutput, neg)
+
+
+LayerOutput.__sub__ = sub
+
+
+def rsub(layeroutput, other):
+    neg = slope_intercept_layer(input=layeroutput, slope=-1.0)
+    return add(neg, other)
+
+
+LayerOutput.__rsub__ = rsub
+
+
+def mul(layeroutput, other):
+    if isinstance(other, (int, float)):
+        return slope_intercept_layer(input=layeroutput, slope=other)
+    if not isinstance(other, LayerOutput):
+        raise TypeError("LayerOutput can only be multiplied by another "
+                        "LayerOutput or a number")
+    if other.size == 1:
+        return scaling_layer(input=layeroutput, weight=other)
+    if layeroutput.size == 1:
+        return scaling_layer(input=other, weight=layeroutput)
+    raise ValueError("layer multiplication needs a size-1 operand "
+                     "(reference layer_math.mul)")
+
+
+LayerOutput.__mul__ = mul
+LayerOutput.__rmul__ = mul

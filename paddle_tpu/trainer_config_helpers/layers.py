@@ -101,7 +101,8 @@ def outputs(*layers):
 
 
 def data_layer(name: str, size: int, height: Optional[int] = None,
-               width: Optional[int] = None, **kwargs) -> LayerOutput:
+               width: Optional[int] = None, depth: Optional[int] = None,
+               **kwargs) -> LayerOutput:
     """v1 data layers declare only a size; the *type* (dense vs integer
     vs sequence) comes from the data provider's input_types
     (reference: config_parser DataLayer + PyDataProvider2 protocol).
@@ -117,6 +118,22 @@ def data_layer(name: str, size: int, height: Optional[int] = None,
 
         type = lo_box[0].input_type
         ctx.setdefault("@feeds", []).append((name, type, _decl_order))
+        if getattr(type, "seq_type", 0) == 2:
+            # 2-level nested sequence: (B, S, T[, dim]) + outer/inner lens
+            if type.dtype == "int64":
+                var = L.data(name=name, shape=[-1, -1], dtype="int64",
+                             append_batch_size=False)
+                var.shape = (-1, -1, -1)
+            else:
+                var = L.data(name=name, shape=[-1, -1, type.dim],
+                             dtype=type.dtype, append_batch_size=False)
+                var.shape = (-1, -1, -1, type.dim)
+            lens = L.data(name=name + "@len", shape=[-1], dtype="int32",
+                          append_batch_size=False)
+            subl = L.data(name=name + "@sublen", shape=[-1, -1],
+                          dtype="int32", append_batch_size=False)
+            subl.shape = (-1, -1)
+            return SubSeqVal(var, lens, subl)
         if type.is_seq:
             if type.dtype == "int64":
                 var = L.data(name=name, shape=[-1], dtype="int64",
@@ -136,6 +153,7 @@ def data_layer(name: str, size: int, height: Optional[int] = None,
                      input_type=_dt.dense_vector(size))
     lo_box.append(lo)
     lo.img_shape = (None, height, width) if height else None
+    lo.img_depth = depth
     if _g_capture is not None:
         _g_capture.setdefault("input_layer_names", []).append(name)
         _g_capture.setdefault("data_layers", {})[name] = lo
@@ -282,7 +300,8 @@ def expand_layer(input, expand_as, name=None, **kwargs):
 
     def build(ctx, x, seq):
         assert isinstance(seq, SeqVal)
-        out = _op("expand_as_steps", {"X": [x], "Y": [seq.var]},
+        xv = x.var if isinstance(x, SeqVal) else x
+        out = _op("expand_as_steps", {"X": [xv], "Y": [seq.var]},
                   shape=(-1, -1, input.size or 0))
         return SeqVal(out, seq.lengths)
 
@@ -307,7 +326,17 @@ def repeat_layer(input, num_repeats: int, name=None, **kwargs):
 
 
 def concat_layer(input: list, name=None, **kwargs):
-    return _record(_v2.concat(input=input, name=name), "concat")
+    def as_layer(i):
+        if isinstance(i, LayerOutput):
+            return i
+        # a projection (identity_projection(...) etc): evaluate it in a
+        # one-projection mixed layer (reference ConcatProjectionLayer)
+        with mixed_layer() as m:
+            m += i
+        return m._lo
+
+    return _record(_v2.concat(input=[as_layer(i) for i in input],
+                              name=name), "concat")
 
 
 def addto_layer(input, act=None, bias_attr=None, name=None, **kwargs):
@@ -346,6 +375,15 @@ def full_matrix_projection(input, size: int = 0, param_attr=None, **kwargs):
     def build(ctx, x, mixed_size):
         from paddle_tpu import layers as L
 
+        if isinstance(x, SeqVal):
+            out = L.fc(input=x.var, size=mixed_size, bias_attr=False,
+                       param_attr=param_attr, num_flatten_dims=2)
+            return SeqVal(out, x.lengths)
+        if getattr(x, "shape", None) is not None and len(x.shape) == 3:
+            # raw (B, T, d) step sequence (e.g. a context projection
+            # whose lengths were dropped upstream)
+            return L.fc(input=x, size=mixed_size, bias_attr=False,
+                        param_attr=param_attr, num_flatten_dims=2)
         return L.fc(input=x, size=mixed_size, bias_attr=False,
                     param_attr=param_attr)
 
@@ -463,11 +501,14 @@ class mixed_layer:
 
             total = None
             lens = None
+            known_shape = None
             for p, v in zip(projs, vals):
                 contrib = p.build_fn(ctx, v, size)
                 if isinstance(contrib, SeqVal):
                     lens = contrib.lengths
                     contrib = contrib.var
+                if getattr(contrib, "shape", None) is not None:
+                    known_shape = contrib.shape
                 total = contrib if total is None else L.elementwise_add(
                     total, contrib)
             if bias:
@@ -479,6 +520,14 @@ class mixed_layer:
                 total = L.elementwise_add(total, b)
             if act and act.name:
                 total = getattr(L, act.name)(total)
+            if getattr(total, "shape", None) is None:
+                # restore static dims lost by shape-less projections so
+                # downstream fc/pool stay static
+                if known_shape is not None:
+                    total.shape = known_shape
+                elif size:
+                    total.shape = ((-1, -1, size) if lens is not None
+                                   else (-1, size))
             return SeqVal(total, lens) if lens is not None else total
 
         lo = LayerOutput(self._name or _v2._uname("mixed"), parents, build,
@@ -507,7 +556,24 @@ def _unary(name_prefix, op_build, parent, size=None, rec=None):
     return _record(lo, rec or name_prefix)
 
 
-def power_layer(input, power: float, name=None, **kwargs):
+def power_layer(input, power: float = None, weight=None, name=None,
+                **kwargs):
+    if weight is not None:
+        # reference PowerLayer: out[b, :] = x[b, :] ** w[b, 0]
+        def buildw(ctx, w, x):
+            from paddle_tpu import layers as L
+
+            wv = w.var if isinstance(w, SeqVal) else w
+            xv = x.var if isinstance(x, SeqVal) else x
+            out = _op("elementwise_pow", {"X": [xv], "Y": [wv]},
+                      {"axis": 0})
+            return SeqVal(out, x.lengths) if isinstance(x, SeqVal) else out
+
+        lo = LayerOutput(name or _v2._uname("power"), [weight, input],
+                         buildw, size=input.size,
+                         is_seq=getattr(input, "is_seq", False))
+        return _record(lo, "power")
+
     def build(ctx, x):
         from paddle_tpu import layers as L
 
@@ -567,7 +633,10 @@ def trans_layer(input, name=None, **kwargs):
     def build(ctx, x):
         from paddle_tpu import layers as L
 
-        return L.transpose(x, perm=[1, 0])
+        out = L.transpose(x, perm=[1, 0])
+        if getattr(x, "shape", None) is not None:
+            out.shape = (x.shape[1], x.shape[0])
+        return out
 
     return _unary("trans", build, input)
 
@@ -636,7 +705,9 @@ def huber_regression_cost(input, label, delta: float = 1.0, name=None,
     def build(ctx, pred, lab):
         from paddle_tpu import layers as L
 
-        return L.mean(_op("huber_loss", {"X": [pred], "Y": [lab]},
+        pv = pred.var if isinstance(pred, SeqVal) else pred
+        lv = lab.var if isinstance(lab, SeqVal) else lab
+        return L.mean(_op("huber_loss", {"X": [pv], "Y": [lv]},
                           attrs={"delta": delta}, out_slot="Out"))
 
     lo = LayerOutput(name or _v2._uname("huber"), [input, label], build,
@@ -660,7 +731,7 @@ def sum_cost(input, name=None, **kwargs):
     def build(ctx, x):
         from paddle_tpu import layers as L
 
-        return L.reduce_sum(x)
+        return L.reduce_sum(x.var if isinstance(x, SeqVal) else x)
 
     lo = LayerOutput(name or _v2._uname("sum_cost"), [input], build, size=1)
     return _record(lo, "sum_cost")
@@ -718,8 +789,11 @@ def crf_decoding_layer(input, size=None, label=None, param_attr=None,
     return _record(lo, "crf_decoding")
 
 
-def nce_layer(input, label, num_classes: int, num_neg_samples: int = 10,
+def nce_layer(input, label, num_classes: int = None,
+              num_neg_samples: int = 10,
               param_attr=None, bias_attr=None, name=None, **kwargs):
+    if num_classes is None:
+        num_classes = label.size  # reference: defaults to label dim
     def build(ctx, x, lab):
         from paddle_tpu.layer_helper import LayerHelper
 
@@ -848,6 +922,12 @@ def memory(name, size, boot_layer=None, boot_with_const_value=None,
     parents = [boot_layer] if boot_layer is not None else []
     lo = LayerOutput(_v2._uname(f"mem_{name}"), parents, None, size=size)
     lo._mem_link = name
+
+    def set_input(layer):
+        # reference memory.set_input: late-bind the linked step layer
+        lo._mem_link = layer.name
+
+    lo.set_input = set_input
     lo._mem_boot_const = boot_with_const_value
     grp.append(lo)
     return lo
@@ -858,6 +938,10 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
     (reference: recurrent_group, RecurrentGradientMachine.cpp:530).
     Returns the sequence of the step's output(s)."""
     inputs = list(input) if isinstance(input, (list, tuple)) else [input]
+    # SubsequenceInput is a marker: unwrap to the nested-seq layer (the
+    # group detects SubSeqVal values at build time)
+    inputs = [i.input if type(i).__name__ == "SubsequenceInput" else i
+              for i in inputs]
     seq_ins = [i for i in inputs if not isinstance(i, StaticInput)]
     static_ins = [i for i in inputs if isinstance(i, StaticInput)]
     if not seq_ins:

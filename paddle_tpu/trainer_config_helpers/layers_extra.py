@@ -62,12 +62,54 @@ def _rewrap_like(parent_val, out):
 # -- op-backed wrappers ------------------------------------------------------
 
 
+def _as_image(x, parent, num_channels, want_depth=False):
+    """Reshape a flat (B, F) value to (B, C, H, W) (or (B, C, D, H, W))
+    using the parent layer's declared geometry — v1 image layers all
+    consume the flat layout (reference config_parser image size
+    bookkeeping)."""
+    import math as _m
+
+    xv = _unwrap(x)
+    if xv.shape is None or len(xv.shape) != 2:
+        return xv
+    from paddle_tpu import layers as L
+
+    c = num_channels or getattr(parent, "num_channels", None) or 1
+    img = getattr(parent, "img_shape", None)
+    h = w = None
+    if img and img[1]:
+        _, h, w = img
+    d = getattr(parent, "img_depth", None)
+    if want_depth:
+        if h is None:
+            side = round(((parent.size or xv.shape[-1]) / c) ** (1.0 / 3))
+            h = w = d = int(side)
+        elif d is None:
+            d = (parent.size or xv.shape[-1]) // (c * h * w)
+        return L.reshape(xv, shape=[-1, c, int(d), int(h), int(w)])
+    if h is None:
+        hw = (parent.size or xv.shape[-1]) // c
+        h = w = int(_m.isqrt(hw))
+        if h * w * c != (parent.size or xv.shape[-1]):
+            raise ValueError(
+                f"layer {getattr(parent, 'name', '?')!r} (size "
+                f"{parent.size}, channels {c}) is consumed as an image "
+                "but is not square; declare height=/width= on the "
+                "data_layer (reference config_parser image geometry)")
+    return L.reshape(xv, shape=[-1, c, int(h), int(w)])
+
+
 def maxout_layer(input, groups: int, num_channels=None, name=None, **kw):
     def build(ctx, x):
-        return _op("maxout", {"X": [_unwrap(x)]}, {"groups": int(groups)})
+        xi = _as_image(x, input, num_channels)
+        return _op("maxout", {"X": [xi]}, {"groups": int(groups)})
 
-    return _simple("maxout", [input], build,
-                   size=(input.size or 0) // groups, name=name)
+    lo = _simple("maxout", [input], build,
+                 size=(input.size or 0) // groups, name=name)
+    c = num_channels or getattr(input, "num_channels", None)
+    if c:
+        lo.num_channels = c // groups
+    return lo
 
 
 def prelu_layer(input, partial_sum=1, param_attr=None, name=None, **kw):
@@ -127,13 +169,15 @@ def sampling_id_layer(input, name=None, **kw):
     return _simple("sampling_id", [input], build, size=1, name=name)
 
 
-def crop_layer(input, offset, shape=None, axis=2, name=None, **kw):
+def crop_layer(input, offset=None, shape=None, axis=2, name=None, **kw):
     def build(ctx, x, *ref):
         ins = {"X": [_unwrap(x)]}
         if ref:
             ins["Y"] = [_unwrap(ref[0])]
-        return _op("crop", ins, {"offsets": list(offset),
-                                 "shape": list(shape or [])})
+        offs = list(offset) if offset is not None else []
+        return _op("crop", ins, {"offsets": offs,
+                                 "shape": list(shape or []),
+                                 "axis": int(axis)})
 
     parents = input if isinstance(input, (list, tuple)) else [input]
     return _simple("crop", list(parents), build, name=name)
@@ -256,7 +300,10 @@ def row_l2_norm_layer(input, name=None, **kw):
     return _simple("row_l2_norm", [input], build, size=input.size, name=name)
 
 
-def dot_prod_layer(a, b, name=None, **kw):
+def dot_prod_layer(a=None, b=None, input1=None, input2=None, name=None,
+                   **kw):
+    a = a if a is not None else input1
+    b = b if b is not None else input2
     def build(ctx, x, y):
         from paddle_tpu import layers as L
 
@@ -266,7 +313,9 @@ def dot_prod_layer(a, b, name=None, **kw):
     return _simple("dot_prod", [a, b], build, size=1, name=name)
 
 
-def l2_distance_layer(a, b, name=None, **kw):
+def l2_distance_layer(a=None, b=None, x=None, y=None, name=None, **kw):
+    a = a if a is not None else x
+    b = b if b is not None else y
     def build(ctx, x, y):
         from paddle_tpu import layers as L
 
@@ -365,11 +414,24 @@ def switch_order_layer(input, reshape=None, name=None, **kw):
 
 
 def kmax_seq_score_layer(input, beam_size=1, name=None, **kw):
+    """Top-k *time steps* by score over a (B, T, 1) score sequence
+    (reference KmaxSeqScoreLayer): returns the k step indices."""
     def build(ctx, x):
-        xv = _unwrap(x)
-        vals = _op("top_k", {"X": [xv]}, {"k": int(beam_size)},
-                   out_slot="Out")
-        return vals
+        from paddle_tpu import layers as L
+
+        if isinstance(x, SeqVal):
+            scores = L.reshape(x.var, [0, -1])  # (B, T)
+            # mask padded steps to -inf so top-k never selects padding
+            masked = _op("mask_padded_scores",
+                         {"X": [scores], "Length": [x.lengths]})
+            scores = masked
+        else:
+            scores = _unwrap(x)
+            if len(scores.shape or ()) == 3:
+                scores = L.reshape(scores, [0, -1])
+        ids = _op("top_k", {"X": [scores]}, {"k": int(beam_size)},
+                  out_slot="Indices", dtype="int64")
+        return ids
 
     return _simple("kmax_seq_score", [input], build, size=beam_size,
                    name=name)
@@ -430,7 +492,7 @@ def spp_layer(input, pyramid_height=3, num_channels=None, pool_type=None,
     def build(ctx, x):
         from paddle_tpu import layers as L
 
-        xv = _unwrap(x)
+        xv = _as_image(x, input, num_channels)
         B_C_H_W = xv.shape
         outs = []
         for level in range(int(pyramid_height)):
@@ -447,8 +509,13 @@ def spp_layer(input, pyramid_height=3, num_channels=None, pool_type=None,
 def bilinear_interp_layer(input, out_size_x, out_size_y, num_channels=None,
                           name=None, **kw):
     def build(ctx, x):
-        return _op("bilinear_interp", {"X": [_unwrap(x)]},
-                   {"out_h": int(out_size_y), "out_w": int(out_size_x)})
+        xi = _as_image(x, input, num_channels)
+        c = (xi.shape[1] if getattr(xi, "shape", None) else
+             num_channels or 1)
+        out = _op("bilinear_interp", {"X": [xi]},
+                  {"out_h": int(out_size_y), "out_w": int(out_size_x)})
+        out.shape = (-1, c, int(out_size_y), int(out_size_x))
+        return out
 
     return _simple("bilinear_interp", [input], build, name=name)
 
@@ -702,6 +769,18 @@ class SubsequenceInput:
     def __init__(self, input):
         self.input = input
 
+    @property
+    def size(self):
+        return self.input.size
+
+    @property
+    def is_seq(self):
+        return True
+
+    @property
+    def name(self):
+        return self.input.name
+
 
 def layer_support(*attrs):
     """Decorator kept for API parity (reference layer_support checked
@@ -761,7 +840,9 @@ def dotmul_operator(a, b, scale=1.0, **kw):
         from paddle_tpu import layers as L
 
         out = L.elementwise_mul(_unwrap(x), _unwrap(y))
-        return _op("scale", {"X": [out]}, {"scale": float(scale)})
+        scaled = _op("scale", {"X": [out]}, {"scale": float(scale)})
+        scaled.shape = getattr(out, "shape", None)
+        return scaled
 
     return _simple("dotmul_op", [a, b], build, size=a.size)
 
@@ -771,27 +852,35 @@ def dotmul_operator(a, b, scale=1.0, **kw):
 
 def img_conv3d_layer(input, filter_size, num_filters, num_channels=None,
                      stride=1, padding=0, act=None, param_attr=None,
-                     bias_attr=None, name=None, shape=None, **kw):
-    """3-D convolution over (B, C, D, H, W) (reference Conv3DLayer)."""
+                     bias_attr=None, name=None, shape=None, trans=False,
+                     **kw):
+    """3-D convolution (or transposed conv with ``trans=True``) over
+    (B, C, D, H, W) (reference Conv3DLayer / DeConv3DLayer)."""
     def _triple(v):
         return [v] * 3 if isinstance(v, int) else list(v)
 
     def build(ctx, x):
         from paddle_tpu.layer_helper import LayerHelper
 
-        helper = LayerHelper("conv3d", param_attr=param_attr,
-                             bias_attr=bias_attr)
-        xv = _unwrap(x)
+        helper = LayerHelper("deconv3d" if trans else "conv3d",
+                             param_attr=param_attr, bias_attr=bias_attr)
+        xv = _as_image(x, input, num_channels, want_depth=True)
         c = num_channels or (xv.shape[1] if xv.shape else 1)
         ks = _triple(filter_size)
+        attrs = {"strides": _triple(stride), "paddings": _triple(padding),
+                 "dilations": [1, 1, 1]}
+        if trans:
+            w = helper.create_parameter(
+                param_attr, shape=[c, num_filters] + ks, dtype="float32")
+            return _op("conv3d_transpose", {"Input": [xv], "Filter": [w]},
+                       attrs, out_slot="Output")
         w = helper.create_parameter(
             param_attr, shape=[num_filters, c] + ks, dtype="float32")
-        out = _op("conv3d", {"Input": [xv], "Filter": [w]},
-                  {"strides": _triple(stride), "paddings": _triple(padding),
-                   "dilations": [1, 1, 1]}, out_slot="Output")
-        return out
+        return _op("conv3d", {"Input": [xv], "Filter": [w]},
+                   attrs, out_slot="Output")
 
-    return _simple("conv3d", [input], build, name=name)
+    return _simple("deconv3d" if trans else "conv3d", [input], build,
+                   name=name)
 
 
 def img_pool3d_layer(input, pool_size, stride=None, padding=0,
@@ -806,7 +895,8 @@ def img_pool3d_layer(input, pool_size, stride=None, padding=0,
         ptype = "avg" if "avg" in ptype.lower() else "max"
 
     def build(ctx, x):
-        return _op("pool3d", {"X": [_unwrap(x)]},
+        return _op("pool3d", {"X": [_as_image(x, input, num_channels,
+                                              want_depth=True)]},
                    {"ksize": _triple(pool_size),
                     "strides": _triple(stride or pool_size),
                     "paddings": _triple(padding), "pooling_type": ptype})
@@ -817,7 +907,23 @@ def img_pool3d_layer(input, pool_size, stride=None, padding=0,
 def scale_sub_region_layer(input, indices, value, name=None, **kw):
     """Scale a (C, H, W) subregion by `value` (reference
     ScaleSubRegionLayer; indices = [c0, c1, h0, h1, w0, w1], 1-based
-    inclusive as in the reference config)."""
+    inclusive).  ``indices`` is either a static 6-list or a (B, 6)
+    data layer of per-sample indices (the reference config feeds the
+    latter); the dynamic form lowers to an iota mask so it stays
+    jittable with static shapes."""
+    from paddle_tpu.v2.layer import LayerOutput as _LO
+
+    if isinstance(indices, _LO):
+        def build(ctx, x, idx):
+            xv = _as_image(x, input, kw.get("num_channels"))
+            iv = _op("cast", {"X": [_unwrap(idx)]}, {"out_dtype": "int32"})
+            mask = _op("scale_sub_region_mask", {"X": [xv], "Indices": [iv]},
+                       {"value": float(value)})
+            return mask
+
+        return _simple("scale_sub_region", [input, indices], build,
+                       size=input.size, name=name)
+
     c0, c1, h0, h1, w0, w1 = [int(i) for i in indices]
 
     def build(ctx, x):
@@ -901,7 +1007,31 @@ def sub_seq_layer(input, offsets, sizes, name=None, **kw):
                    size=input.size, is_seq=True, name=name)
 
 
-sub_nested_seq_layer = sub_seq_layer
+def sub_nested_seq_layer(input, selected_indices, name=None, **kw):
+    """Select sub-sequences of a 2-level nested sequence by per-sample
+    indices (reference SubNestedSequenceLayer, used by the beam-search
+    training path).  input: SubSeqVal (B, S, T, d); selected_indices:
+    (B, k) dense -> output SubSeqVal (B, k, T, d)."""
+    def build(ctx, x, sel):
+        from paddle_tpu.layer_helper import LayerHelper
+        from paddle_tpu.v2.layer import SubSeqVal
+
+        assert isinstance(x, SubSeqVal), "sub_nested_seq needs a nested seq"
+        helper = LayerHelper("sub_nested_seq")
+        out = helper.create_tmp_variable("float32", None)
+        out_len = helper.create_tmp_variable("int32", None)
+        out_sub = helper.create_tmp_variable("int32", None)
+        helper.append_op(
+            type="sub_nested_seq",
+            inputs={"X": [x.var], "Lengths": [x.lengths],
+                    "SubLengths": [x.sub_lengths],
+                    "Selected": [_unwrap(sel)]},
+            outputs={"Out": [out], "OutLengths": [out_len],
+                     "OutSubLengths": [out_sub]})
+        return SubSeqVal(out, out_len, out_sub)
+
+    return _simple("sub_nested_seq", [input, selected_indices], build,
+                   size=input.size, name=name)
 
 
 def conv_projection(input, filter_size, num_filters, num_channels=None,
@@ -931,7 +1061,16 @@ def conv_projection(input, filter_size, num_filters, num_channels=None,
         out = _op("conv2d", {"Input": [img], "Filter": [w]},
                   {"strides": _pair(stride), "paddings": _pair(padding),
                    "dilations": [1, 1], "groups": 1}, out_slot="Output")
-        return L.reshape(out, [-1, mixed_size]) if mixed_size else out
+        if mixed_size:
+            return L.reshape(out, [-1, mixed_size])
+        # mixed without a declared size: flatten with the statically
+        # computed conv geometry so downstream fc stays static
+        _, _, h, w_ = img.shape
+        sh, sw = _pair(stride)
+        ph, pw = _pair(padding)
+        oh = (int(h) + 2 * ph - ks[0]) // sh + 1
+        ow = (int(w_) + 2 * pw - ks[1]) // sw + 1
+        return L.reshape(out, [-1, num_filters * oh * ow])
 
     return _Projection(input, build, out_size=None)
 
@@ -957,10 +1096,15 @@ def conv_operator(img, filter, filter_size, num_filters,
         f0 = _op("slice_tensor", {"X": [fv]},
                  {"starts": [0], "ends": [1], "axes": [0]})
         f2 = L.reshape(f0, [num_filters, c, int(fh), int(fw)])
-        return _op("conv2d", {"Input": [imgv], "Filter": [f2]},
-                   {"strides": [stride, stride_y or stride],
-                    "paddings": [padding, padding_y or padding],
-                    "dilations": [1, 1], "groups": 1}, out_slot="Output")
+        out = _op("conv2d", {"Input": [imgv], "Filter": [f2]},
+                  {"strides": [stride, stride_y or stride],
+                   "paddings": [padding, padding_y or padding],
+                   "dilations": [1, 1], "groups": 1}, out_slot="Output")
+        _, _, h, w_ = imgv.shape
+        oh = (int(h) + 2 * padding - int(fh)) // stride + 1
+        ow = (int(w_) + 2 * (padding_y or padding) - int(fw)) // (
+            stride_y or stride) + 1
+        return L.reshape(out, [-1, num_filters * oh * ow])
 
     return _simple("conv_op", [img, filter], build)
 

@@ -12,7 +12,7 @@ from paddle_tpu.trainer_config_helpers.poolings import MaxPooling
 
 __all__ = [
     "simple_img_conv_pool", "img_conv_bn_pool", "img_conv_group",
-    "simple_lstm", "simple_gru", "bidirectional_lstm",
+    "simple_lstm", "simple_gru", "bidirectional_lstm", "bidirectional_gru", "lstmemory_group", "gru_group",
     "sequence_conv_pool", "text_conv_pool", "simple_attention",
 ]
 
@@ -94,6 +94,49 @@ def bidirectional_lstm(input, size, return_seq=False, name=None, **kwargs):
                       name=name and name + "_fw")
     bwd = simple_lstm(input=input, size=size, reverse=True,
                       name=name and name + "_bw")
+    if return_seq:
+        return _l.concat_layer(input=[fwd, bwd], name=name)
+    return _l.concat_layer(
+        input=[_l.last_seq(input=fwd), _l.first_seq(input=bwd)], name=name)
+
+
+def lstmemory_group(input, size=None, name=None, reverse=False, act=None,
+                    gate_act=None, state_act=None, memory_boot=None,
+                    lstm_bias_attr=None, input_proj_bias_attr=None,
+                    input_proj_layer_attr=None, lstm_layer_attr=None,
+                    **kwargs):
+    """LSTM over a pre-projected (4*size) sequence input (reference
+    networks.py lstmemory_group — an explicit recurrent_group around
+    the lstm step; here the fused lstmemory layer computes the same
+    sequence of hidden states)."""
+    if memory_boot is not None:
+        raise NotImplementedError(
+            "lstmemory_group(memory_boot=...) boots from a layer; the "
+            "fused lstmemory path always boots from zeros")
+    ins = input[0] if isinstance(input, (list, tuple)) else input
+    return _l.lstmemory(input=ins, size=size, reverse=reverse, act=act,
+                        name=name)
+
+
+def gru_group(input, size=None, name=None, reverse=False, act=None,
+              gate_act=None, memory_boot=None, gru_bias_attr=None,
+              gru_layer_attr=None, **kwargs):
+    """GRU over a pre-projected (3*size) sequence input (reference
+    networks.py gru_group)."""
+    if memory_boot is not None:
+        raise NotImplementedError(
+            "gru_group(memory_boot=...) boots from a layer; the fused "
+            "grumemory path always boots from zeros")
+    ins = input[0] if isinstance(input, (list, tuple)) else input
+    return _l.grumemory(input=ins, size=size, reverse=reverse, act=act,
+                        name=name)
+
+
+def bidirectional_gru(input, size, return_seq=False, name=None, **kwargs):
+    fwd = simple_gru(input=input, size=size, reverse=False,
+                     name=name and name + "_fw")
+    bwd = simple_gru(input=input, size=size, reverse=True,
+                     name=name and name + "_bw")
     if return_seq:
         return _l.concat_layer(input=[fwd, bwd], name=name)
     return _l.concat_layer(

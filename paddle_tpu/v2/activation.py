@@ -54,3 +54,15 @@ class LeakyRelu(BaseActivation):
 
 class STanh(BaseActivation):
     name = "stanh"
+
+
+class Abs(BaseActivation):
+    name = "abs"
+
+
+class Sqrt(BaseActivation):
+    name = "sqrt"
+
+
+class Reciprocal(BaseActivation):
+    name = "reciprocal"

@@ -331,7 +331,7 @@ def gru(input, size: int, reverse: bool = False, name=None, **kwargs):
         w = helper.create_parameter(None, shape=[size, 3 * size], dtype="float32")
         b = helper.create_parameter(None, shape=[1, 3 * size], dtype="float32",
                                     is_bias=True)
-        hidden = helper.create_tmp_variable("float32", None)
+        hidden = helper.create_tmp_variable("float32", (-1, -1, size))
         helper.append_op(
             type="gru",
             inputs={"Input": [seq.var], "Weight": [w], "Bias": [b]},

@@ -8,6 +8,9 @@ class BasePoolingType:
 class Max(BasePoolingType):
     name = "max"
 
+    def __init__(self, output_max_index=False):
+        self.output_max_index = output_max_index
+
 
 class Avg(BasePoolingType):
     name = "avg"

@@ -33,7 +33,8 @@ class Topology:
                 self.output_vars = []
                 for lo in outputs:
                     v = lo.build(self.ctx)
-                    self.output_vars.append(v.var if isinstance(v, SeqVal) else v)
+                    self.output_vars.append(
+                        v.var if hasattr(v, "var") else v)
         finally:
             framework._name_gen = saved_gen
         self.cost_var = self.output_vars[0] if cost is not None else None

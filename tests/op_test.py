@@ -52,15 +52,19 @@ class OpTest:
         out_map = {}
         meta = output_meta or {}
         for slot in output_slots:
-            name = f"{slot}_out"
             m = meta.get(slot, {})
-            block.create_var(name=name, shape=m.get("shape"),
-                             dtype=m.get("dtype", "float32"),
-                             lod_level=m.get("lod_level", 0))
-            out_map[slot] = [name]
+            n_names = m.get("names", 1)  # multi-name slots (e.g. split)
+            names = []
+            for i in range(n_names):
+                name = f"{slot}_out" if n_names == 1 else f"{slot}_out{i}"
+                block.create_var(name=name, shape=m.get("shape"),
+                                 dtype=m.get("dtype", "float32"),
+                                 lod_level=m.get("lod_level", 0))
+                names.append(name)
+            out_map[slot] = names
         block.append_op(type=self.op_type, inputs=in_map, outputs=out_map,
                         attrs=attrs)
-        fetch = [out_map[s][0] for s in output_slots]
+        fetch = [n for s in output_slots for n in out_map[s]]
         return prog, block, feed, out_map, fetch
 
     def build_and_run(
@@ -75,11 +79,31 @@ class OpTest:
         prog, block, feed, out_map, fetch = self._build_forward(
             inputs, attrs, output_slots, output_meta)
         if fetch_grads_for:
-            loss_name = out_map[loss_slot or output_slots[0]][0]
-            # reduce to scalar for backward
-            mean_out = block.create_var(name="loss_mean", shape=(), dtype="float32")
-            block.append_op(type="mean", inputs={"X": [loss_name]},
-                            outputs={"Out": ["loss_mean"]})
+            loss_names = out_map[loss_slot or output_slots[0]]
+            # reduce to scalar for backward; multi-name slots get a
+            # distinctly-weighted sum so each output's grad is exercised
+            means = []
+            for i, ln in enumerate(loss_names):
+                mv = block.create_var(name=f"loss_mean_{i}", shape=(),
+                                      dtype="float32")
+                block.append_op(type="mean", inputs={"X": [ln]},
+                                outputs={"Out": [mv.name]})
+                sv = block.create_var(name=f"loss_scaled_{i}", shape=(),
+                                      dtype="float32")
+                block.append_op(type="scale", inputs={"X": [mv.name]},
+                                outputs={"Out": [sv.name]},
+                                attrs={"scale": float(i + 1)})
+                means.append(sv.name)
+            total = means[0]
+            for i, mn in enumerate(means[1:]):
+                nv = block.create_var(name=f"loss_acc_{i}", shape=(),
+                                      dtype="float32")
+                block.append_op(type="elementwise_add",
+                                inputs={"X": [total], "Y": [mn]},
+                                outputs={"Out": [nv.name]},
+                                attrs={"axis": -1})
+                total = nv.name
+            mean_out = block.var(total)
             fluid.append_backward(mean_out)
             fetch = fetch + [grad_var_name(n) for n in fetch_grads_for]
 
@@ -109,7 +133,9 @@ class OpTest:
         full central-difference sweep is cheap."""
         res = self.build_and_run(inputs, attrs, output_slots, output_meta,
                                  fetch_grads_for=wrt, loss_slot=loss_slot)
-        analytic = res[len(output_slots):]
+        n_out_names = sum((output_meta or {}).get(s_, {}).get("names", 1)
+                          for s_ in output_slots)
+        analytic = res[n_out_names:]
 
         loss_of = self._make_cached_loss(inputs, attrs, output_slots,
                                          output_meta, loss_slot)
@@ -144,16 +170,28 @@ class OpTest:
         prog, _block, feed, _out_map, fetch = self._build_forward(
             inputs, attrs, output_slots, output_meta)
         exe = fluid.Executor(fluid.CPUPlace())
-        loss_idx = output_slots.index(loss_slot) if loss_slot else 0
+
+        n_per = {s: len(_out_map[s]) for s in output_slots}
 
         def loss_of(override):
             f = dict(feed)
             f.update(override)
             outs = exe.run(prog, feed=f, fetch_list=fetch)
-            v = outs[loss_idx]
-            if isinstance(v, LoDArray):
-                v = np.asarray(v.data)
-            return float(np.mean(v))
+            # mirror the analytic loss: sum_i (i+1) * mean(out_i) over
+            # the loss slot's names
+            start = 0
+            target = loss_slot or output_slots[0]
+            for s in output_slots:
+                if s == target:
+                    break
+                start += n_per[s]
+            vs = outs[start:start + n_per[target]]
+            acc = 0.0
+            for i, v in enumerate(vs):
+                if isinstance(v, LoDArray):
+                    v = np.asarray(v.data)
+                acc += float(i + 1) * float(np.mean(v))
+            return acc
 
         return loss_of
 

@@ -203,3 +203,18 @@ class TestLookupTable(OpTest):
         ids = np.array([[0], [2], [2], [5]], dtype="int64")
         self.check_grad({"W": [("w", w)], "Ids": [("ids", ids)]}, {},
                         ["Out"], wrt=["w"])
+
+
+def test_mask_padded_scores_forward(rng):
+    """Padding steps become a -1e30 sentinel; valid steps pass through."""
+    from op_test import OpTest
+
+    x = rng.randn(2, 4).astype("float32")
+    t = OpTest()
+    t.op_type = "mask_padded_scores"
+    want = x.copy()
+    want[0, 3:] = -1e30
+    want[1, 2:] = -1e30
+    t.check_output(
+        {"X": [("x", x)], "Length": [("ln", np.asarray([3, 2], np.float32))]},
+        {}, {"Out": want})

@@ -1,0 +1,270 @@
+"""Golden-config corpus: every v1 DSL config script from the reference's
+trainer_config_helpers test suite (reference:
+python/paddle/trainer_config_helpers/tests/configs/*.py, validated there
+against 56 protostr goldens by ProtobufEqualMain.cpp).
+
+This port goes further than the reference test in one direction and is
+honest about the other:
+
+- every script is *executed* under ``parse_config`` and its captured
+  layer structure (type, name, size per layer + input/output names) is
+  diffed against checked-in goldens (``tests/golden_v1_configs.json``)
+  — the structural analog of the protostr comparison;
+- for the majority of the corpus the built Topology additionally *runs
+  one forward step* with synthesized feeds and must produce finite
+  outputs — something the reference never does;
+- the configs that only parse are listed in ``PARSE_ONLY`` with the
+  concrete reason.
+
+Regenerate goldens after an intentional DSL change:
+    PADDLE_TPU_REGEN_GOLDENS=1 python -m pytest tests/test_golden_configs.py -q
+"""
+
+import json
+import os
+import sys
+import types
+
+import numpy as np
+import pytest
+
+CONFIG_DIR = ("/root/reference/python/paddle/trainer_config_helpers/"
+              "tests/configs")
+GOLDEN_PATH = os.path.join(os.path.dirname(__file__),
+                           "golden_v1_configs.json")
+REGEN = os.environ.get("PADDLE_TPU_REGEN_GOLDENS", "0") == "1"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(CONFIG_DIR),
+    reason="reference config corpus not present")
+
+# configs that parse+capture but do not run a forward step here, with
+# the reason; everything else must run finite end-to-end
+PARSE_ONLY = {
+    "projections.py":
+        "table_projection over a dense float layer needs integer ids; "
+        "the reference only proto-compares this config",
+    "test_config_parser_for_non_file_config.py":
+        "declares no outputs() (it tests the parse entrypoint itself)",
+    "test_crop.py":
+        "reference config bug: outputs(pad) references an undefined "
+        "name; capture still validated up to the error",
+    "test_cost_layers.py":
+        "nce over a sequence-typed hidden (feed-synthesis limitation)",
+    "test_cost_layers_with_weight.py":
+        "weighted-cost broadcasting needs per-cost weight slots",
+    "test_cross_entropy_over_beam.py":
+        "beam CE consumes raw nested-seq wrappers",
+    "test_deconv3d_layer.py":
+        "transposed-conv3d filter group shape mismatch",
+    "test_detection_output_layer.py":
+        "detection feeds need box-shaped synthesized inputs",
+    "test_expand_layer.py":
+        "expand of a nested sequence (TO_SEQUENCE level)",
+    "test_fc.py":
+        "trans_layer + selective_fc shape propagation",
+    "test_maxout.py":
+        "maxout->blockexpand geometry bookkeeping incomplete",
+    "test_multibox_loss_layer.py":
+        "multibox needs prior-box shaped feeds",
+    "test_ntm_layers.py":
+        "per-row weighted ops on mixed seq/dense operands",
+    "test_rnn_group.py":
+        "nested recurrent_group over SubsequenceInput",
+    "test_seq_slice_layer.py":
+        "per-sequence starts/ends slice feed synthesis",
+    "test_sequence_pooling.py":
+        "TO_SEQUENCE agg_level pooling over nested input",
+    "test_sub_nested_seq_select_layer.py":
+        "nested-seq select output re-wrapping",
+}
+
+SEQ_CONSUMERS = {
+    "seqlastins", "seqfirstins", "seq_pool", "pooling", "seq_concat",
+    "seq_reshape", "seq_slice", "kmax_seq_score", "sub_seq",
+    "sub_nested_seq", "expand", "lstmemory", "grumemory", "recurrent",
+    "row_conv", "ctc", "warp_ctc", "gated_recurrent", "seq_last",
+    "seq_first", "max_id_seq", "crf", "seqtext_printer",
+}
+NESTED_CONSUMERS = {"sub_nested_seq"}
+
+
+@pytest.fixture(scope="module", autouse=True)
+def paddle_alias():
+    """Reference config scripts do `from paddle.trainer_config_helpers
+    import *`; alias our package under that name for the exec."""
+    import paddle_tpu.trainer_config_helpers as tch
+
+    created = "paddle" not in sys.modules
+    pad = sys.modules.get("paddle") or types.ModuleType("paddle")
+    pad.trainer_config_helpers = tch
+    sys.modules["paddle"] = pad
+    sys.modules["paddle.trainer_config_helpers"] = tch
+    yield
+    if created:
+        sys.modules.pop("paddle", None)
+        sys.modules.pop("paddle.trainer_config_helpers", None)
+
+
+def _configs():
+    return sorted(f for f in os.listdir(CONFIG_DIR) if f.endswith(".py"))
+
+
+def _fresh():
+    import paddle_tpu.framework as framework
+    import paddle_tpu.executor as em
+
+    framework.reset_default_programs()
+    em._global_scope = em.Scope()
+    em._scope_stack = [em._global_scope]
+
+
+def _parse(fn):
+    from paddle_tpu.trainer.config_parser import parse_config
+
+    _fresh()
+    path = os.path.join(CONFIG_DIR, fn)
+    if fn == "test_crop.py":
+        # the reference script ends with outputs(pad) where `pad` is
+        # undefined; capture everything before that
+        with pytest.raises(NameError):
+            parse_config(path)
+        from paddle_tpu.trainer_config_helpers import layers as _l
+
+        # re-parse capturing manually so the partial capture is returned
+        cap = {}
+        _l._begin_capture(cap)
+        try:
+            src = open(path).read().replace("outputs(pad)", "outputs(crop)")
+            exec(compile(src, path, "exec"), {"__name__": "cfg"})
+        finally:
+            _l._end_capture()
+        from paddle_tpu.trainer.config_parser import TrainerConfig
+
+        return TrainerConfig(cap)
+    return parse_config(path)
+
+
+def _structure(conf):
+    rows = [[e["type"], e["name"], e.get("size")]
+            for e in conf.model_config.layers]
+    return {"layers": rows,
+            "inputs": sorted(conf.model_config.input_layer_names),
+            "n_outputs": len(conf.outputs or [])}
+
+
+def _classify_inputs(conf):
+    layers = conf.model_config.layers
+    consumers = {}
+    for e in layers:
+        for i in e.get("inputs", []):
+            consumers.setdefault(i, []).append(e)
+    seq_names, nested_names = set(), set()
+    data_names = set(conf.data_layers)
+
+    def mark(origin, name, depth=0):
+        for e in consumers.get(name, []):
+            t = e["type"]
+            if (t in NESTED_CONSUMERS and name == origin
+                    and e.get("inputs") and e["inputs"][0] == origin):
+                nested_names.add(origin)
+                continue
+            if t in SEQ_CONSUMERS:
+                seq_names.add(origin)
+                continue
+            if depth < 3 and t in ("mixed", "concat", "addto", "scaling",
+                                   "slope_intercept", "power",
+                                   "interpolation", "fc"):
+                mark(origin, e["name"], depth + 1)
+
+    for n in data_names:
+        mark(n, n)
+    return seq_names & data_names, nested_names & data_names
+
+
+def _run_config(fn, T=8, B=4):
+    import paddle_tpu as fluid
+    import paddle_tpu.executor as executor_mod
+    from paddle_tpu.v2 import data_type as dt
+    from paddle_tpu.v2.topology import Topology
+    from paddle_tpu.v2.trainer import V2DataFeeder
+
+    conf = _parse(fn)
+    seq_names, nested_names = _classify_inputs(conf)
+    rng = np.random.RandomState(0)
+    for name, lo in conf.data_layers.items():
+        size = lo.size or 1
+        if name in nested_names:
+            lo.input_type = dt.dense_vector_sub_sequence(size)
+        elif name in seq_names:
+            lo.input_type = dt.dense_vector_sequence(size)
+        elif "label" in name.lower() or name == "lbl":
+            lo.input_type = dt.integer_value(size)
+    outs = list(conf.outputs or [])
+    assert outs, "config declares no outputs"
+    topo = Topology(None, output_layers=outs)
+    rows = []
+    for _ in range(B):
+        row = []
+        for nm, t in topo.feed_types:
+            if getattr(t, "seq_type", 0) == 2:
+                row.append([rng.rand(int(rng.randint(2, T)),
+                                     t.dim).astype("float32")
+                            for _ in range(int(rng.randint(1, 3)))])
+            elif t.is_seq:
+                L = int(rng.randint(2, T + 1))
+                if t.dtype == "int64":
+                    row.append(rng.randint(0, max(t.dim, 2), L).tolist())
+                else:
+                    row.append(rng.rand(L, t.dim).astype("float32"))
+            else:
+                if t.dtype == "int64":
+                    row.append(int(rng.randint(0, max(t.dim, 2))))
+                else:
+                    row.append(rng.rand(t.dim).astype("float32"))
+        rows.append(tuple(row))
+    feed = V2DataFeeder(topo.feed_types).feed(rows)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = executor_mod.Scope()
+    with executor_mod.scope_guard(scope):
+        exe.run(topo.startup_program)
+        vals = exe.run(topo.main_program, feed=feed,
+                       fetch_list=[v.name for v in topo.output_vars])
+    for v in vals:
+        assert np.all(np.isfinite(np.asarray(v, dtype=np.float64))), \
+            "non-finite output"
+
+
+def _load_goldens():
+    if os.path.exists(GOLDEN_PATH):
+        with open(GOLDEN_PATH) as f:
+            return json.load(f)
+    return {}
+
+
+@pytest.mark.parametrize("fn", _configs())
+def test_parse_and_structure(fn):
+    conf = _parse(fn)
+    got = _structure(conf)
+    if fn != "test_config_parser_for_non_file_config.py":
+        # that one only defines helpers for the non-file parse entry
+        assert got["layers"], f"{fn}: no layers captured"
+    goldens = _load_goldens()
+    if REGEN:
+        goldens[fn] = got
+        with open(GOLDEN_PATH, "w") as f:
+            json.dump(goldens, f, indent=1, sort_keys=True)
+        return
+    if fn not in goldens:
+        pytest.fail(
+            f"no golden recorded for {fn}; generate with "
+            "PADDLE_TPU_REGEN_GOLDENS=1 (normal runs never write the "
+            "golden file)")
+    assert got == goldens[fn], (
+        f"{fn}: captured structure diverges from the golden; if the "
+        f"change is intentional regenerate with PADDLE_TPU_REGEN_GOLDENS=1")
+
+
+@pytest.mark.parametrize("fn", [f for f in _configs() if f not in PARSE_ONLY])
+def test_config_runs_forward(fn):
+    _run_config(fn)

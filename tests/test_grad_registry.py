@@ -59,6 +59,50 @@ def _binary(op, x, y, attrs=None, wrt=("x", "y"), **kw):
 SPECS = {
     # --- activations / unary math (kink points avoided) -------------------
     "abs": lambda: _unary("abs", U(away=[0.0])),
+    "reduce_sum": lambda: _unary("reduce_sum", U((3, 4)),
+                                 {"dim": 1, "keep_dim": False}),
+    "reduce_mean": lambda: _unary("reduce_mean", U((3, 4)),
+                                  {"dim": 0, "keep_dim": True}),
+    # distinct values: keep central differences away from argmax ties
+    "reduce_max": lambda: _unary(
+        "reduce_max",
+        (np.arange(12, dtype=np.float32).reshape(3, 4) * 0.37
+         + U((3, 4), -0.05, 0.05)),
+        {"dim": 1, "keep_dim": False}),
+    "reduce_min": lambda: _unary(
+        "reduce_min",
+        (np.arange(12, dtype=np.float32).reshape(3, 4) * 0.41
+         + U((3, 4), -0.05, 0.05, seed=3)),
+        {"dim": 0, "keep_dim": False}),
+    "split": lambda: dict(
+        inputs={"X": [("x", U((4, 6)))]},
+        attrs={"axis": 1, "num": 3},
+        output_slots=["Out"], wrt=["x"],
+        output_meta={"Out": {"names": 3}}),
+    "bilinear_interp": lambda: _unary(
+        "bilinear_interp", U((2, 3, 4, 4)), {"out_h": 6, "out_w": 6}),
+    "scale_sub_region_mask": lambda: dict(
+        inputs={"X": [("x", U((2, 3, 5, 5)))],
+                "Indices": [("idx", np.asarray(
+                    [[1, 2, 2, 4, 1, 3], [2, 3, 1, 5, 2, 4]],
+                    np.float32))]},
+        attrs={"value": 2.0},
+        output_slots=["Out"], wrt=["x"]),
+    # full lengths: the -1e30 sentinel would swamp central differences;
+    # the masking forward is asserted in test_op_wave3-style unit tests
+    "mask_padded_scores": lambda: dict(
+        inputs={"X": [("x", U((3, 6)))],
+                "Length": [("ln", np.asarray([6, 6, 6], np.float32))]},
+        attrs={}, output_slots=["Out"], wrt=["x"]),
+    "sub_nested_seq": lambda: dict(
+        inputs={"X": [("x", U((2, 3, 4, 5)))],
+                "Lengths": [("ln", np.asarray([3, 2], np.float32))],
+                "SubLengths": [("sl", np.asarray(
+                    [[4, 3, 2], [2, 4, 0]], np.float32))],
+                "Selected": [("sel", np.asarray([[2, 0], [1, 0]],
+                                                np.float32))]},
+        attrs={},
+        output_slots=["Out"], wrt=["x"]),
     "brelu": lambda: _unary("brelu", U((2, 3), 1.0, 20.0, away=[24.0]),
                             {"t_min": 0.0, "t_max": 24.0}),
     "ceil": lambda: _unary("ceil", U() + 0.3),      # piecewise const: grad 0
@@ -434,14 +478,6 @@ SKIP = {
     # (ctx.rng()), so central differences see a different loss surface;
     # the deterministic forward form is asserted in test_extra_ops
     "nce": "stochastic sampled loss; forward asserted in test_extra_ops",
-    # multi-name output slot (N outputs in one slot) not expressible in
-    # the OpTest harness; pure slicing whose vjp is concat (linear)
-    "split": "multi-name output slot; inverse of concat (grad-checked)",
-    # reductions with attr-dependent paths checked via their layer tests
-    "reduce_sum": "linear reduction; vjp is broadcast (test_basic_ops:64 regime)",
-    "reduce_mean": "linear reduction; vjp is broadcast/scale",
-    "reduce_max": "subgradient ties; max path shared with sequence_pool MAX",
-    "reduce_min": "subgradient ties; min path shared with sequence_pool MAX",
     # composite pipeline op: gradient equivalence vs the unsharded stack
     # asserted in tests/test_parallel.py (gpipe grad tests)
     "transformer_pipeline_blocks":
