@@ -129,11 +129,39 @@ def bench_train_from_loader(paths, batch, steps=60):
     float(np.asarray(l))
     dt_staged = (time.perf_counter() - t0) / steps
 
+    # double-buffered loop: decode + device_put of batch N+1 issued
+    # while step N executes (the trainer's prefetch=True path)
+    import jax
+
+    dl2 = DataLoader(paths, num_threads=2, capacity=64)
+    it2 = iter(dl2)
+    xs, ys = decode(next(it2), batch)
+    staged = {"img": jax.device_put(xs), "label": jax.device_put(ys)}
+    (l,) = exe.run(feed=staged, fetch_list=[loss], return_numpy=False)
+    float(np.asarray(l))
+    t0 = time.perf_counter()
+    done = 0
+    # step 1 consumes the pre-staged buffer (no decode cost in-loop) and
+    # the final iteration stages a buffer that is never run; the two
+    # biases cancel to first order over the 60-step window
+    for rec in it2:
+        if done >= steps:
+            break
+        (l,) = exe.run(feed=staged, fetch_list=[loss], return_numpy=False)
+        xs, ys = decode(rec, batch)
+        staged = {"img": jax.device_put(xs), "label": jax.device_put(ys)}
+        done += 1
+    float(np.asarray(l))
+    dt_prefetch = (time.perf_counter() - t0) / max(done, 1)
+    dl2.close()
+
     print(json.dumps({
         "bench": "train_smallnet_bs%d" % batch,
         "ms_per_step_loader_fed": round(dt_loader * 1e3, 2),
+        "ms_per_step_loader_prefetch": round(dt_prefetch * 1e3, 2),
         "ms_per_step_prestaged": round(dt_staged * 1e3, 2),
-        "pipeline_overhead_ms": round((dt_loader - dt_staged) * 1e3, 2)}))
+        "pipeline_overhead_ms": round((dt_loader - dt_staged) * 1e3, 2),
+        "prefetch_overhead_ms": round((dt_prefetch - dt_staged) * 1e3, 2)}))
 
 
 def main():

@@ -3,18 +3,25 @@
  * C rebuild of the reference's capi (reference:
  * paddle/capi/gradient_machine.h:36-73
  * paddle_gradient_machine_create_for_inference_with_parameters /
- * _forward; paddle/capi/main.h:27 paddle_init).  The reference bound C
- * to the legacy C++ GradientMachine; the TPU-native equivalent binds C
- * to the compiling executor through an embedded CPython, so a C/C++
- * application can run a model saved with
- * paddle_tpu.io.save_inference_model with no Python code of its own.
- * The heavy lifting (XLA compile, TPU execution) happens exactly as in
- * the Python path; the embedded interpreter is control plane only,
- * mirroring how the reference embedded Python for PyDataProvider2
- * (paddle/utils/PythonUtil.h).
+ * _forward; paddle/capi/main.h:27 paddle_init).  Two implementations
+ * share this header, both loading models saved with
+ * paddle_tpu.io.save_inference_model:
  *
- * Thread-safety: calls are serialized on the embedded interpreter's
- * GIL.  All functions return 0 on success, nonzero on error
+ * - libpaddle_tpu_capi (paddle_tpu_capi.cc): binds C to the compiling
+ *   executor through an EMBEDDED CPython — the full framework surface
+ *   (any op, any backend incl. the TPU), but the deployment box needs
+ *   libpython + the package.  Control plane only, mirroring how the
+ *   reference embedded Python for PyDataProvider2
+ *   (paddle/utils/PythonUtil.h).  Calls serialize on the GIL.
+ * - libpaddle_tpu_capi_native (paddle_tpu_capi_native.cc): a
+ *   PYTHON-FREE C++ interpreter over the saved program — nothing but
+ *   libc/libstdc++ on the link line, matching the reference capi's
+ *   link-into-anything deployment contract.  Covers the exported-MLP
+ *   op set (mul, elementwise add/sub/mul, relu/sigmoid/tanh/softmax/
+ *   scale/exp/abs/square, reshape, dropout + batch_norm in inference
+ *   form) and errors with a clear redirect for anything else.
+ *
+ * All functions return 0 on success, nonzero on error
  * (pd_last_error() gives the message, like paddle_error +
  * paddle_error_string).
  */

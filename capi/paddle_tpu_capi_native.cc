@@ -1,0 +1,577 @@
+// Python-free implementation of the pd_* inference API
+// (paddle_tpu_capi.h): a self-contained C++ interpreter over the
+// JSON-serialized inference program + .npy parameters written by
+// paddle_tpu.io.save_inference_model.
+//
+// Reference contract: paddle/capi/gradient_machine.h:36-73 — a C
+// library deployable with no interpreter on the box.  The embedded-
+// CPython implementation (paddle_tpu_capi.cc) remains the full-surface
+// fallback; this library covers the exported-MLP op set (mul,
+// elementwise add/mul/sub, relu/sigmoid/tanh/softmax/scale, reshape,
+// dropout/batch_norm in inference form) and fails with a clear error
+// naming any op outside it.
+//
+// Build:   g++ -O2 -shared -fPIC -o libpaddle_tpu_capi_native.so \
+//              paddle_tpu_capi_native.cc
+// Link:    cc app.c -lpaddle_tpu_capi_native      (no Python anywhere)
+
+#include "paddle_tpu_capi.h"
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::string g_last_error;
+
+int Fail(const std::string& msg) {
+  g_last_error = msg;
+  return 1;
+}
+
+// ---------------------------------------------------------------------------
+// minimal JSON (objects/arrays/strings/numbers/bool/null) — the saved
+// __model__.json uses nothing else
+// ---------------------------------------------------------------------------
+
+struct Json {
+  enum Kind { kNull, kBool, kNum, kStr, kArr, kObj } kind = kNull;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::vector<Json> arr;
+  std::map<std::string, Json> obj;
+
+  const Json* Get(const std::string& k) const {
+    auto it = obj.find(k);
+    return it == obj.end() ? nullptr : &it->second;
+  }
+};
+
+struct JsonParser {
+  std::string buf;  // owned: callers may pass temporaries
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  explicit JsonParser(std::string s)
+      : buf(std::move(s)), p(buf.data()), end(buf.data() + buf.size()) {}
+
+  void Skip() {
+    while (p < end && (*p == ' ' || *p == '\n' || *p == '\t' || *p == '\r'))
+      ++p;
+  }
+
+  bool Eat(char c) {
+    Skip();
+    if (p < end && *p == c) {
+      ++p;
+      return true;
+    }
+    return false;
+  }
+
+  Json Parse() {
+    Skip();
+    Json j;
+    if (p >= end) {
+      ok = false;
+      return j;
+    }
+    char c = *p;
+    if (c == '{') {
+      ++p;
+      j.kind = Json::kObj;
+      Skip();
+      if (Eat('}')) return j;
+      while (ok) {
+        Json key = Parse();
+        if (!ok || key.kind != Json::kStr || !Eat(':')) {
+          ok = false;
+          break;
+        }
+        j.obj[key.str] = Parse();
+        if (Eat(',')) continue;
+        if (Eat('}')) break;
+        ok = false;
+      }
+    } else if (c == '[') {
+      ++p;
+      j.kind = Json::kArr;
+      Skip();
+      if (Eat(']')) return j;
+      while (ok) {
+        j.arr.push_back(Parse());
+        if (Eat(',')) continue;
+        if (Eat(']')) break;
+        ok = false;
+      }
+    } else if (c == '"') {
+      ++p;
+      j.kind = Json::kStr;
+      while (p < end && *p != '"') {
+        if (*p == '\\' && p + 1 < end) {
+          ++p;
+          switch (*p) {
+            case 'n': j.str += '\n'; break;
+            case 't': j.str += '\t'; break;
+            case 'r': j.str += '\r'; break;
+            case 'u': {  // \uXXXX: keep ascii subset, else '?'
+              if (p + 4 < end) {
+                unsigned v = std::stoul(std::string(p + 1, p + 5), nullptr, 16);
+                j.str += v < 128 ? static_cast<char>(v) : '?';
+                p += 4;
+              }
+              break;
+            }
+            default: j.str += *p;
+          }
+        } else {
+          j.str += *p;
+        }
+        ++p;
+      }
+      if (p < end) ++p;  // closing quote
+    } else if (std::strncmp(p, "true", 4) == 0) {
+      j.kind = Json::kBool;
+      j.b = true;
+      p += 4;
+    } else if (std::strncmp(p, "false", 5) == 0) {
+      j.kind = Json::kBool;
+      p += 5;
+    } else if (std::strncmp(p, "null", 4) == 0) {
+      p += 4;
+    } else {
+      j.kind = Json::kNum;
+      char* e = nullptr;
+      j.num = std::strtod(p, &e);
+      if (e == p) ok = false;
+      p = e;
+    }
+    return j;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// tensors + .npy loading
+// ---------------------------------------------------------------------------
+
+struct Tensor {
+  std::vector<int64_t> dims;
+  std::vector<float> data;  // everything is f32 at this API's boundary
+
+  int64_t numel() const {
+    int64_t n = 1;
+    for (auto d : dims) n *= d;
+    return n;
+  }
+};
+
+bool LoadNpy(const std::string& path, Tensor* t, std::string* err) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) {
+    *err = "cannot open " + path;
+    return false;
+  }
+  char magic[6];
+  f.read(magic, 6);
+  if (std::memcmp(magic, "\x93NUMPY", 6) != 0) {
+    *err = path + ": not an npy file";
+    return false;
+  }
+  unsigned char ver[2];
+  f.read(reinterpret_cast<char*>(ver), 2);
+  uint32_t hlen = 0;
+  if (ver[0] == 1) {
+    uint16_t h16;
+    f.read(reinterpret_cast<char*>(&h16), 2);
+    hlen = h16;
+  } else {
+    f.read(reinterpret_cast<char*>(&hlen), 4);
+  }
+  std::string header(hlen, '\0');
+  f.read(header.data(), hlen);
+  auto find = [&](const std::string& key) -> std::string {
+    auto pos = header.find(key);
+    if (pos == std::string::npos) return "";
+    pos = header.find(':', pos);
+    auto endp = header.find(',', pos);
+    // shape tuples contain commas; extend to the closing paren
+    auto paren = header.find('(', pos);
+    if (paren != std::string::npos && paren < endp) {
+      endp = header.find(')', paren);
+      if (endp != std::string::npos) ++endp;
+    }
+    return header.substr(pos + 1, endp - pos - 1);
+  };
+  std::string descr = find("'descr'");
+  std::string shape = find("'shape'");
+  if (find("'fortran_order'").find("True") != std::string::npos) {
+    *err = path + ": fortran order unsupported";
+    return false;
+  }
+  t->dims.clear();
+  for (size_t i = 0; i < shape.size();) {
+    if (isdigit(shape[i])) {
+      size_t j = i;
+      while (j < shape.size() && isdigit(shape[j])) ++j;
+      t->dims.push_back(std::stoll(shape.substr(i, j - i)));
+      i = j;
+    } else {
+      ++i;
+    }
+  }
+  int64_t n = 1;
+  for (auto d : t->dims) n *= d;
+  t->data.resize(n);
+  if (descr.find("<f4") != std::string::npos) {
+    f.read(reinterpret_cast<char*>(t->data.data()), n * 4);
+  } else if (descr.find("<f8") != std::string::npos) {
+    std::vector<double> tmp(n);
+    f.read(reinterpret_cast<char*>(tmp.data()), n * 8);
+    for (int64_t i = 0; i < n; ++i) t->data[i] = static_cast<float>(tmp[i]);
+  } else if (descr.find("<i8") != std::string::npos) {
+    std::vector<int64_t> tmp(n);
+    f.read(reinterpret_cast<char*>(tmp.data()), n * 8);
+    for (int64_t i = 0; i < n; ++i) t->data[i] = static_cast<float>(tmp[i]);
+  } else if (descr.find("<i4") != std::string::npos) {
+    std::vector<int32_t> tmp(n);
+    f.read(reinterpret_cast<char*>(tmp.data()), n * 4);
+    for (int64_t i = 0; i < n; ++i) t->data[i] = static_cast<float>(tmp[i]);
+  } else {
+    *err = path + ": unsupported dtype " + descr;
+    return false;
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// the interpreter
+// ---------------------------------------------------------------------------
+
+struct Machine {
+  Json model;
+  std::map<std::string, Tensor> values;   // params + activations
+  std::vector<std::string> feed_names;
+  std::vector<std::string> fetch_names;
+  std::map<std::string, Tensor> staged;
+  std::vector<Tensor> outputs;
+};
+
+const Json* FirstIn(const Json& op, const char* slot) {
+  const Json* ins = op.Get("inputs");
+  if (!ins) return nullptr;
+  const Json* names = ins->Get(slot);
+  if (!names || names->arr.empty()) return nullptr;
+  return &names->arr[0];
+}
+
+std::string OutName(const Json& op, const char* slot) {
+  const Json* outs = op.Get("outputs");
+  if (!outs) return "";
+  const Json* names = outs->Get(slot);
+  if (!names || names->arr.empty()) return "";
+  return names->arr[0].str;
+}
+
+double AttrNum(const Json& op, const char* key, double dflt) {
+  const Json* attrs = op.Get("attrs");
+  if (!attrs) return dflt;
+  const Json* v = attrs->Get(key);
+  if (!v) return dflt;
+  if (v->kind == Json::kNum) return v->num;
+  if (v->kind == Json::kBool) return v->b ? 1 : 0;
+  return dflt;
+}
+
+int RunOp(Machine* m, const Json& op) {
+  const std::string type = op.Get("type") ? op.Get("type")->str : "";
+  auto val = [&](const char* slot) -> Tensor* {
+    const Json* n = FirstIn(op, slot);
+    if (!n) return nullptr;
+    auto it = m->values.find(n->str);
+    return it == m->values.end() ? nullptr : &it->second;
+  };
+
+  if (type == "feed" || type == "fetch") return 0;
+
+  if (type == "mul") {
+    Tensor* x = val("X");
+    Tensor* y = val("Y");
+    if (!x || !y) return Fail("mul: missing input");
+    int64_t k = y->dims[0];
+    int64_t n = y->dims[1];
+    int64_t mrows = x->numel() / k;
+    Tensor out;
+    out.dims = {mrows, n};
+    out.data.assign(mrows * n, 0.f);
+    for (int64_t i = 0; i < mrows; ++i)
+      for (int64_t kk = 0; kk < k; ++kk) {
+        float a = x->data[i * k + kk];
+        if (a == 0.f) continue;
+        const float* yr = &y->data[kk * n];
+        float* orow = &out.data[i * n];
+        for (int64_t j = 0; j < n; ++j) orow[j] += a * yr[j];
+      }
+    m->values[OutName(op, "Out")] = std::move(out);
+    return 0;
+  }
+  if (type == "elementwise_add" || type == "elementwise_sub" ||
+      type == "elementwise_mul") {
+    Tensor* x = val("X");
+    Tensor* y = val("Y");
+    if (!x || !y) return Fail(type + ": missing input");
+    Tensor out = *x;
+    int64_t n = x->numel();
+    int64_t yn = y->numel();
+    for (int64_t i = 0; i < n; ++i) {
+      float b = y->data[yn == n ? i : i % yn];  // trailing broadcast
+      float a = x->data[i];
+      out.data[i] = type == "elementwise_add"   ? a + b
+                    : type == "elementwise_sub" ? a - b
+                                                : a * b;
+    }
+    m->values[OutName(op, "Out")] = std::move(out);
+    return 0;
+  }
+  if (type == "relu" || type == "sigmoid" || type == "tanh" ||
+      type == "exp" || type == "abs" || type == "square") {
+    Tensor* x = val("X");
+    if (!x) return Fail(type + ": missing input");
+    Tensor out = *x;
+    for (auto& v : out.data) {
+      if (type == "relu") v = v > 0 ? v : 0;
+      else if (type == "sigmoid") v = 1.f / (1.f + std::exp(-v));
+      else if (type == "tanh") v = std::tanh(v);
+      else if (type == "exp") v = std::exp(v);
+      else if (type == "abs") v = std::fabs(v);
+      else v = v * v;
+    }
+    m->values[OutName(op, "Out")] = std::move(out);
+    return 0;
+  }
+  if (type == "softmax") {
+    Tensor* x = val("X");
+    if (!x) return Fail("softmax: missing input");
+    Tensor out = *x;
+    int64_t cols = x->dims.back();
+    int64_t rows = x->numel() / cols;
+    for (int64_t r = 0; r < rows; ++r) {
+      float* row = &out.data[r * cols];
+      float mx = row[0];
+      for (int64_t c = 1; c < cols; ++c) mx = std::max(mx, row[c]);
+      float sum = 0;
+      for (int64_t c = 0; c < cols; ++c) {
+        row[c] = std::exp(row[c] - mx);
+        sum += row[c];
+      }
+      for (int64_t c = 0; c < cols; ++c) row[c] /= sum;
+    }
+    m->values[OutName(op, "Out")] = std::move(out);
+    return 0;
+  }
+  if (type == "scale") {
+    Tensor* x = val("X");
+    if (!x) return Fail("scale: missing input");
+    float s = static_cast<float>(AttrNum(op, "scale", 1.0));
+    float b = static_cast<float>(AttrNum(op, "bias", 0.0));
+    Tensor out = *x;
+    for (auto& v : out.data) v = v * s + b;
+    m->values[OutName(op, "Out")] = std::move(out);
+    return 0;
+  }
+  if (type == "reshape") {
+    Tensor* x = val("X");
+    if (!x) return Fail("reshape: missing input");
+    Tensor out = *x;
+    const Json* attrs = op.Get("attrs");
+    const Json* shape = attrs ? attrs->Get("shape") : nullptr;
+    if (shape) {
+      out.dims.clear();
+      int64_t known = 1, wild = -1;
+      for (size_t i = 0; i < shape->arr.size(); ++i) {
+        int64_t d = static_cast<int64_t>(shape->arr[i].num);
+        if (d == 0) d = x->dims[i];
+        out.dims.push_back(d);
+        if (d == -1) wild = static_cast<int64_t>(i);
+        else known *= d;
+      }
+      if (wild >= 0) out.dims[wild] = x->numel() / known;
+    }
+    m->values[OutName(op, "Out")] = std::move(out);
+    return 0;
+  }
+  if (type == "dropout") {  // inference: identity
+    Tensor* x = val("X");
+    if (!x) return Fail("dropout: missing input");
+    m->values[OutName(op, "Out")] = *x;
+    return 0;
+  }
+  if (type == "batch_norm") {  // inference form: running stats
+    Tensor* x = val("X");
+    Tensor* scale = val("Scale");
+    Tensor* bias = val("Bias");
+    Tensor* mean = val("Mean");
+    Tensor* var = val("Variance");
+    if (!x || !scale || !bias || !mean || !var)
+      return Fail("batch_norm: missing input");
+    float eps = static_cast<float>(AttrNum(op, "epsilon", 1e-5));
+    int64_t c = scale->numel();
+    Tensor out = *x;
+    int64_t inner = 1;  // NCHW: dims after channel axis 1
+    for (size_t i = 2; i < x->dims.size(); ++i) inner *= x->dims[i];
+    int64_t n = x->numel();
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t ch = (i / inner) % c;
+      float inv = 1.f / std::sqrt(var->data[ch] + eps);
+      out.data[i] =
+          (x->data[i] - mean->data[ch]) * inv * scale->data[ch] +
+          bias->data[ch];
+    }
+    m->values[OutName(op, "Y")] = std::move(out);
+    return 0;
+  }
+  return Fail("native capi: op '" + type +
+              "' not in the Python-free op set; use the embedded-Python "
+              "libpaddle_tpu_capi for this model");
+}
+
+}  // namespace
+
+extern "C" {
+
+int pd_init(const char* /*repo_root*/) { return 0; }  // nothing to boot
+
+int pd_machine_create_for_inference(pd_machine* machine,
+                                    const char* model_dir) {
+  auto m = std::make_unique<Machine>();
+  std::string dir(model_dir);
+  std::ifstream mf(dir + "/__model__.json");
+  if (!mf) return Fail("cannot open " + dir + "/__model__.json");
+  std::stringstream ss;
+  ss << mf.rdbuf();
+  JsonParser parser(ss.str());
+  m->model = parser.Parse();
+  if (!parser.ok || m->model.kind != Json::kObj)
+    return Fail("malformed __model__.json");
+  for (auto& v : m->model.Get("feed_names")->arr)
+    m->feed_names.push_back(v.str);
+  for (auto& v : m->model.Get("fetch_names")->arr)
+    m->fetch_names.push_back(v.str);
+
+  std::ifstream man(dir + "/MANIFEST.json");
+  if (!man) return Fail("cannot open " + dir + "/MANIFEST.json");
+  std::stringstream ms;
+  ms << man.rdbuf();
+  JsonParser mparser(ms.str());
+  Json manifest = mparser.Parse();
+  const Json* vars = manifest.Get("vars");
+  if (!mparser.ok || !vars) return Fail("malformed MANIFEST.json");
+  for (auto& kv : vars->obj) {
+    Tensor t;
+    std::string err;
+    if (!LoadNpy(dir + "/" + kv.first + ".npy", &t, &err)) return Fail(err);
+    m->values[kv.first] = std::move(t);
+  }
+  *machine = m.release();
+  return 0;
+}
+
+int pd_machine_feed_f32(pd_machine machine, const char* name,
+                        const float* data, const int64_t* dims, int ndim) {
+  if (!machine) return Fail("null machine");
+  auto* m = static_cast<Machine*>(machine);
+  Tensor t;
+  int64_t n = 1;
+  for (int i = 0; i < ndim; ++i) {
+    t.dims.push_back(dims[i]);
+    n *= dims[i];
+  }
+  t.data.assign(data, data + n);
+  m->staged[name] = std::move(t);
+  return 0;
+}
+
+int pd_machine_feed_i64(pd_machine machine, const char* name,
+                        const int64_t* data, const int64_t* dims, int ndim) {
+  if (!machine) return Fail("null machine");
+  auto* m = static_cast<Machine*>(machine);
+  Tensor t;
+  int64_t n = 1;
+  for (int i = 0; i < ndim; ++i) {
+    t.dims.push_back(dims[i]);
+    n *= dims[i];
+  }
+  t.data.resize(n);
+  for (int64_t i = 0; i < n; ++i) t.data[i] = static_cast<float>(data[i]);
+  m->staged[name] = std::move(t);
+  return 0;
+}
+
+int pd_machine_forward(pd_machine machine) {
+  if (!machine) return Fail("null machine");
+  auto* m = static_cast<Machine*>(machine);
+  for (auto& kv : m->staged) m->values[kv.first] = kv.second;
+  m->staged.clear();
+  const Json* prog = m->model.Get("program");
+  if (!prog) return Fail("model has no program");
+  const Json* blocks = prog->Get("blocks");
+  if (!blocks || blocks->arr.empty()) return Fail("program has no blocks");
+  const Json* ops = blocks->arr[0].Get("ops");
+  if (!ops) return Fail("block has no ops");
+  for (auto& op : ops->arr)
+    if (RunOp(m, op) != 0) return 1;
+  m->outputs.clear();
+  for (auto& name : m->fetch_names) {
+    auto it = m->values.find(name);
+    if (it == m->values.end()) return Fail("fetch var missing: " + name);
+    m->outputs.push_back(it->second);
+  }
+  return 0;
+}
+
+int pd_machine_output_count(pd_machine machine) {
+  if (!machine) return -1;
+  return static_cast<int>(static_cast<Machine*>(machine)->outputs.size());
+}
+
+int pd_machine_output_dims(pd_machine machine, int i, int64_t* dims,
+                           int* ndim) {
+  if (!machine) return Fail("null machine");
+  auto* m = static_cast<Machine*>(machine);
+  if (i < 0 || i >= static_cast<int>(m->outputs.size()))
+    return Fail("output index out of range");
+  const auto& d = m->outputs[i].dims;
+  int n = static_cast<int>(d.size());
+  for (int k = 0; k < n && k < *ndim; ++k) dims[k] = d[k];
+  *ndim = n;
+  return 0;
+}
+
+int pd_machine_output_f32(pd_machine machine, int i, float* buf,
+                          uint64_t cap) {
+  if (!machine) return Fail("null machine");
+  auto* m = static_cast<Machine*>(machine);
+  if (i < 0 || i >= static_cast<int>(m->outputs.size()))
+    return Fail("output index out of range");
+  const auto& t = m->outputs[i];
+  if (static_cast<uint64_t>(t.numel()) > cap)
+    return Fail("output buffer too small");
+  std::memcpy(buf, t.data.data(), t.numel() * sizeof(float));
+  return 0;
+}
+
+void pd_machine_destroy(pd_machine machine) {
+  delete static_cast<Machine*>(machine);
+}
+
+const char* pd_last_error(void) { return g_last_error.c_str(); }
+
+}  // extern "C"

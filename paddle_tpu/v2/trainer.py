@@ -190,24 +190,78 @@ class SGD:
 
     def train(self, reader: Callable, num_passes: int = 1,
               event_handler: Optional[Callable] = None,
-              feeding: Optional[Dict[str, int]] = None):
+              feeding: Optional[Dict[str, int]] = None,
+              prefetch: bool = False):
+        """``prefetch=True`` double-buffers the input pipeline: batch
+        N+1 is decoded and staged on device (``jax.device_put``) while
+        step N executes, and the per-step host sync on the cost is
+        deferred one step (reference shape:
+        gserver/dataproviders/DataProvider.h double-buffer design).
+        EndIteration events are then emitted one step late, with exact
+        cost values.  Remote (pserver) training ignores the flag: the
+        remote step already overlaps communication, and its per-step
+        protocol needs the synchronous loop."""
         event_handler = event_handler or (lambda e: None)
         feeder = V2DataFeeder(self.topology.feed_types, feeding)
         fetch = [self.topology.cost_var]
         for pass_id in range(num_passes):
             event_handler(v2_event.BeginPass(pass_id))
-            for batch_id, data in enumerate(reader()):
-                event_handler(v2_event.BeginIteration(pass_id, batch_id))
-                feed = feeder.feed(data)
-                if self._remote is not None:
-                    cost = self._remote_step(feed, fetch)
-                else:
-                    with executor_mod.scope_guard(self.parameters.scope):
-                        (cost,) = self._exe.run(self.topology.main_program,
-                                                feed=feed, fetch_list=fetch)
-                event_handler(v2_event.EndIteration(
-                    pass_id, batch_id, float(np.asarray(cost).reshape(-1)[0])))
+            if prefetch and self._remote is None:
+                self._train_pass_prefetch(reader, feeder, fetch, pass_id,
+                                          event_handler)
+            else:
+                for batch_id, data in enumerate(reader()):
+                    event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                    feed = feeder.feed(data)
+                    if self._remote is not None:
+                        cost = self._remote_step(feed, fetch)
+                    else:
+                        with executor_mod.scope_guard(self.parameters.scope):
+                            (cost,) = self._exe.run(
+                                self.topology.main_program,
+                                feed=feed, fetch_list=fetch)
+                    event_handler(v2_event.EndIteration(
+                        pass_id, batch_id,
+                        float(np.asarray(cost).reshape(-1)[0])))
             event_handler(v2_event.EndPass(pass_id))
+
+    def _train_pass_prefetch(self, reader, feeder, fetch, pass_id,
+                             event_handler):
+        import jax
+
+        pending = None  # (batch_id, device cost)
+        try:
+            it = enumerate(reader())
+            nxt = next(it, None)
+            staged = None
+            if nxt is not None:
+                staged = {k: jax.device_put(v)
+                          for k, v in feeder.feed(nxt[1]).items()}
+            while nxt is not None:
+                batch_id, _ = nxt
+                event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                with executor_mod.scope_guard(self.parameters.scope):
+                    (cost,) = self._exe.run(self.topology.main_program,
+                                            feed=staged, fetch_list=fetch,
+                                            return_numpy=False)
+                # stage batch N+1 while the device executes step N
+                nxt = next(it, None)
+                if nxt is not None:
+                    staged = {k: jax.device_put(v)
+                              for k, v in feeder.feed(nxt[1]).items()}
+                if pending is not None:
+                    pid, pcost = pending
+                    event_handler(v2_event.EndIteration(
+                        pass_id, pid,
+                        float(np.asarray(pcost).reshape(-1)[0])))
+                pending = (batch_id, cost)
+        finally:
+            # a failure in step N must not drop step N-1's completed
+            # EndIteration (handlers checkpoint/log on it)
+            if pending is not None:
+                pid, pcost = pending
+                event_handler(v2_event.EndIteration(
+                    pass_id, pid, float(np.asarray(pcost).reshape(-1)[0])))
 
     def test(self, reader: Callable, feeding: Optional[Dict[str, int]] = None):
         if self._test_program is None:

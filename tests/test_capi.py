@@ -82,3 +82,59 @@ def test_c_program_reports_bad_model_dir(capi_binary, tmp_path):
                          capture_output=True, text=True, env=env, timeout=300)
     assert out.returncode == 1
     assert "create failed" in out.stderr
+
+
+@pytest.fixture(scope="module")
+def capi_native_binary(tmp_path_factory):
+    """The Python-free library + example binary: NOTHING from
+    python3-config appears on either command line."""
+    d = tmp_path_factory.mktemp("capi_native")
+    lib = os.path.join(str(d), "libpaddle_tpu_capi_native.so")
+    exe = os.path.join(str(d), "dense_infer_native")
+    subprocess.run(
+        ["g++", "-std=c++17", "-O2", "-shared", "-fPIC",
+         os.path.join(CAPI, "paddle_tpu_capi_native.cc"), "-o", lib],
+        check=True, capture_output=True)
+    subprocess.run(
+        ["g++", "-O2", os.path.join(CAPI, "examples", "dense_infer.c"),
+         "-o", exe, "-I", CAPI, lib, f"-Wl,-rpath,{d}"],
+        check=True, capture_output=True)
+    # the deployment claim itself: no libpython in the link closure
+    ldd = subprocess.run(["ldd", exe], capture_output=True, text=True)
+    assert "libpython" not in ldd.stdout, ldd.stdout
+    return exe
+
+
+def test_native_c_program_matches_python_inference(capi_native_binary,
+                                                   saved_model):
+    """reference capi contract (paddle/capi/gradient_machine.h:36-73):
+    link-into-anything inference with no interpreter on the box."""
+    model_dir, dim, expected = saved_model
+    env = dict(os.environ)
+    env.pop("PADDLE_TPU_ROOT", None)  # truly standalone
+    out = subprocess.run([capi_native_binary, model_dir, str(dim)],
+                         capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0, out.stderr
+    line = [l for l in out.stdout.splitlines()
+            if l.startswith("output:")][0]
+    got = np.array([float(t) for t in line.split()[1:]], np.float32)
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_native_c_program_names_unsupported_op(capi_native_binary,
+                                               tmp_path):
+    """Models outside the native op set fail with a clear redirect to
+    the embedded-Python library, not silence."""
+    import paddle_tpu as fluid
+
+    fluid.framework.reset_default_programs()
+    x = fluid.layers.data(name="x", shape=[1, 8, 8], dtype="float32")
+    conv = fluid.layers.conv2d(input=x, num_filters=2, filter_size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    d = str(tmp_path / "convmodel")
+    fluid.io.save_inference_model(d, ["x"], [conv], exe)
+    out = subprocess.run([capi_native_binary, d, "64"],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 1
+    assert "conv2d" in out.stderr and "embedded-Python" in out.stderr
