@@ -249,6 +249,10 @@ bool LoadNpy(const std::string& path, Tensor* t, std::string* err) {
     *err = path + ": unsupported dtype " + descr;
     return false;
   }
+  if (!f.good()) {  // short read = truncated file, not silent zeros
+    *err = path + ": truncated npy data";
+    return false;
+  }
   return true;
 }
 
@@ -331,6 +335,18 @@ int RunOp(Machine* m, const Json& op) {
     Tensor out = *x;
     int64_t n = x->numel();
     int64_t yn = y->numel();
+    // only exact-shape or trailing broadcast (Y = X's trailing dims,
+    // e.g. a bias over the last axis) is implemented; reject others
+    // loudly rather than cycling Y down the flattened X
+    int64_t trailing = 1;
+    for (size_t d = x->dims.size(); d-- > 0;) {
+      trailing *= x->dims[d];
+      if (trailing == yn) break;
+      if (trailing > yn) { trailing = -1; break; }
+    }
+    if (yn != n && trailing != yn)
+      return Fail(type + ": Y shape is neither X's shape nor X's "
+                  "trailing dims; use the embedded-Python capi");
     for (int64_t i = 0; i < n; ++i) {
       float b = y->data[yn == n ? i : i % yn];  // trailing broadcast
       float a = x->data[i];
@@ -461,10 +477,13 @@ int pd_machine_create_for_inference(pd_machine* machine,
   m->model = parser.Parse();
   if (!parser.ok || m->model.kind != Json::kObj)
     return Fail("malformed __model__.json");
-  for (auto& v : m->model.Get("feed_names")->arr)
-    m->feed_names.push_back(v.str);
-  for (auto& v : m->model.Get("fetch_names")->arr)
-    m->fetch_names.push_back(v.str);
+  const Json* feeds = m->model.Get("feed_names");
+  const Json* fetches = m->model.Get("fetch_names");
+  if (!feeds || !fetches)
+    return Fail("__model__.json missing feed_names/fetch_names "
+                "(not a save_inference_model export?)");
+  for (auto& v : feeds->arr) m->feed_names.push_back(v.str);
+  for (auto& v : fetches->arr) m->fetch_names.push_back(v.str);
 
   std::ifstream man(dir + "/MANIFEST.json");
   if (!man) return Fail("cannot open " + dir + "/MANIFEST.json");

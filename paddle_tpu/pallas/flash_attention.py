@@ -19,8 +19,10 @@ attention.  TPU design:
   saved per-row logsumexp (no S x S residual).
 
 Used by ``ops/attention_ops.py`` local attention and as the per-shard
-block kernel of ring attention (parallel/ring_attention.py) via the
-carry-in variant (``flash_block_update``).
+chunk kernel of ring attention (parallel/ring_attention.py) via
+``flash_attention_with_lse`` — chunks merge in log-sum-exp space, and
+the lse cotangent folds into the backward's delta term so the ring
+gradient stays exact.
 """
 
 from __future__ import annotations
@@ -238,13 +240,17 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "interpret"))
 def _flash_bwd_impl(q, k, v, o, lse, do, causal: bool, scale: float,
-                    interpret: bool = False):
+                    interpret: bool = False, dlse=None):
     BH, S, D = q.shape
     Sk = k.shape[1]
     blk_q = _pick_block(S)
     blk_k = _pick_block(Sk)
     nq, nk = S // blk_q, Sk // blk_k
     delta = jnp.sum(do.astype(_F32) * o.astype(_F32), axis=-1)  # (BH, S)
+    if dlse is not None:
+        # joint (out, lse) cotangent: d lse/d s = p, so the lse
+        # cotangent folds into the delta term of ds = p*(dp - delta)
+        delta = delta - dlse.astype(_F32)
     lse3 = lse.reshape(BH, nq, blk_q)
     delta3 = delta.reshape(BH, nq, blk_q)
 
@@ -302,33 +308,48 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal: bool, scale: float,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def flash_attention(q, k, v, causal: bool = False, scale: float = None,
                     interpret: bool = False):
     """q, k, v: (BH, S, D) -> out (BH, S, D).
 
     Callers with (B, H, S, D) reshape to (B*H, S, D) first (free).
+    Thin wrapper over ``flash_attention_with_lse`` (the lse output's
+    cotangent is simply zero here).
     """
-    if scale is None:
-        scale = q.shape[-1] ** -0.5
-    out, _ = _flash_fwd_impl(q, k, v, causal, scale, interpret)
+    out, _lse = flash_attention_with_lse(q, k, v, causal, scale,
+                                         interpret)
     return out
 
 
-def _fa_fwd(q, k, v, causal, scale, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention_with_lse(q, k, v, causal: bool = False,
+                             scale: float = None, interpret: bool = False):
+    """Like ``flash_attention`` but also returns the per-row logsumexp
+    (BH, S) — the quantity ring attention needs to merge per-chunk
+    results exactly.  Differentiable in BOTH outputs (the lse cotangent
+    folds into the backward's delta term)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _flash_fwd_impl(q, k, v, causal, scale, interpret)
+
+
+def _fa_lse_fwd(q, k, v, causal, scale, interpret):
     if scale is None:
         scale = q.shape[-1] ** -0.5
     out, lse = _flash_fwd_impl(q, k, v, causal, scale, interpret)
-    return out, (q, k, v, out, lse)
+    return (out, lse), (q, k, v, out, lse)
 
 
-def _fa_bwd(causal, scale, interpret, res, do):
+def _fa_lse_bwd(causal, scale, interpret, res, cots):
     q, k, v, out, lse = res
+    do, dlse = cots
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    # an all-zeros lse cotangent (the flash_attention wrapper's case)
+    # folds into delta as a no-op, so no special-casing is needed
     dq, dk, dv = _flash_bwd_impl(q, k, v, out, lse, do, causal, scale,
-                                 interpret)
+                                 interpret, dlse=dlse)
     return dq, dk, dv
 
 
-flash_attention.defvjp(_fa_fwd, _fa_bwd)
+flash_attention_with_lse.defvjp(_fa_lse_fwd, _fa_lse_bwd)

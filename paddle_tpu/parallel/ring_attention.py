@@ -46,6 +46,17 @@ def _online_block(q, k, v, bias, m, l, acc, scale):
     return m_new, l_new, acc_new
 
 
+def _use_flash_chunks(B, H, S, D) -> bool:
+    from paddle_tpu import pallas as pk
+    from paddle_tpu.pallas import flash_attention as fa
+
+    if pk.mode() == "off" or not fa.fits(B, H, S, D):
+        return False
+    if pk.mode() == "on":
+        return True
+    return pk._auto_ok() and S >= 1024
+
+
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
                    scale: Optional[float] = None):
     """Attention over sequence shards.  Call inside ``shard_map`` (or
@@ -54,14 +65,26 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
 
     q, k, v: (B, H, S_local, D); returns (B, H, S_local, D).
     ``causal`` masks by *global* position, computed from the shard index.
+
+    Per-shard chunk math: when the local shapes fit, each (q_local,
+    kv_chunk) block runs the Pallas flash kernel (no S_local x S_chunk
+    score tensor in HBM) and chunks merge in log-sum-exp space; causal
+    masking resolves at the ring level — chunks strictly ahead of this
+    shard skip their FLOPs entirely, the diagonal chunk runs the
+    kernel's causal mask, earlier chunks run unmasked.  Shapes the
+    kernel rejects fall back to the jnp online-softmax block.
     """
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, H, S, D = q.shape
     if scale is None:
         scale = D ** -0.5
-    qf = q.astype(jnp.float32)
 
+    if _use_flash_chunks(B, H, S, D):
+        return _ring_attention_flash(q, k, v, axis_name, causal, scale,
+                                     n, idx)
+
+    qf = q.astype(jnp.float32)
     m0 = jnp.full((B, H, S), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((B, H, S), jnp.float32)
     a0 = jnp.zeros((B, H, S, D), jnp.float32)
@@ -89,6 +112,62 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
                                     jnp.arange(n))
     l = jnp.where(l == 0.0, 1.0, l)
     return (acc / l[..., None]).astype(q.dtype)
+
+
+def _ring_attention_flash(q, k, v, axis_name, causal, scale, n, idx):
+    """Ring attention with the Pallas flash kernel as the per-chunk
+    block: chunk results (normalized out, lse) merge in log-sum-exp
+    space, which is exact and keeps the backward pass flowing through
+    the kernel's custom vjp plus elementwise merge algebra."""
+    from paddle_tpu import pallas as pk
+    from paddle_tpu.pallas.flash_attention import flash_attention_with_lse
+
+    B, H, S, D = q.shape
+    q3 = q.reshape(B * H, S, D)
+    interp = pk.interpret_mode()
+
+    o0 = jnp.zeros((B * H, S, D), jnp.float32)
+    lse0 = jnp.full((B * H, S), -jnp.inf, jnp.float32)
+
+    def step(carry, t):
+        k_cur, v_cur, o, lse = carry
+        src = (idx - t) % n
+        k3 = k_cur.reshape(B * H, S, D)
+        v3 = v_cur.reshape(B * H, S, D)
+
+        def full(_):
+            return flash_attention_with_lse(q3, k3, v3, False, scale,
+                                            interp)
+
+        def diag(_):
+            return flash_attention_with_lse(q3, k3, v3, True, scale,
+                                            interp)
+
+        def skip(_):
+            return (jnp.zeros_like(q3), jnp.full((B * H, S), -jnp.inf,
+                                                 jnp.float32))
+
+        if causal:
+            # 0: src < idx (full), 1: src == idx (diagonal), 2: skip
+            branch = jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2))
+            out_c, lse_c = lax.switch(branch, [full, diag, skip], None)
+        else:
+            out_c, lse_c = full(None)
+
+        lse_new = jnp.logaddexp(lse, lse_c)
+        safe = jnp.where(jnp.isneginf(lse_new), 0.0, lse_new)
+        w_old = jnp.where(jnp.isneginf(lse), 0.0, jnp.exp(lse - safe))
+        w_new = jnp.where(jnp.isneginf(lse_c), 0.0, jnp.exp(lse_c - safe))
+        o = o * w_old[..., None] + out_c.astype(jnp.float32) \
+            * w_new[..., None]
+
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, o, lse_new), None
+
+    (_, _, o, lse), _ = lax.scan(step, (k, v, o0, lse0), jnp.arange(n))
+    return o.reshape(B, H, S, D).astype(q.dtype)
 
 
 def local_attention(q, k, v, causal: bool = False,

@@ -198,6 +198,35 @@ def _to_image(ctx, x, parent: LayerOutput, num_channels):
     return x
 
 
+def _pair_hw(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _out_hw(h, w, k, s, p):
+    """Conv/pool output extent; 0 (= unknown geometry) when the window
+    does not fit, so downstream layers fall back to declared sizes
+    instead of propagating negative extents."""
+    if not h or not w:
+        return 0, 0
+    (kh, kw), (sh, sw), (ph, pw) = _pair_hw(k), _pair_hw(s), _pair_hw(p)
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    return (oh, ow) if oh > 0 and ow > 0 else (0, 0)
+
+
+def _parent_geom(parent, num_channels):
+    """(c, h, w) of a layer consumed as an image, from declared
+    geometry or the square-size heuristic (reference config_parser
+    image size bookkeeping)."""
+    c = num_channels or getattr(parent, "num_channels", None) or 1
+    img = getattr(parent, "img_shape", None)
+    if img and img[1]:
+        return c, int(img[1]), int(img[2])
+    hw = (parent.size or 0) // c
+    side = int(math.isqrt(hw)) if hw > 0 else 0
+    return c, side, side
+
+
 def img_conv_layer(input, filter_size, num_filters, num_channels=None,
                    stride=1, padding=0, act=None, param_attr=None,
                    bias_attr=None, groups=1, name=None, **kwargs):
@@ -211,9 +240,12 @@ def img_conv_layer(input, filter_size, num_filters, num_channels=None,
                         act=(act.name if act else None),
                         param_attr=param_attr, bias_attr=bias_attr)
 
+    _, h, w = _parent_geom(input, num_channels)
+    oh, ow = _out_hw(h, w, filter_size, stride, padding)
     lo = LayerOutput(name or _v2._uname("conv"), [input], build,
-                     size=num_filters)
+                     size=(num_filters * oh * ow) or num_filters)
     lo.num_channels = num_filters
+    lo.img_shape = (None, oh, ow) if oh else None
     return _record(lo, "exconv", num_filters=num_filters,
                    filter_size=filter_size)
 
@@ -230,9 +262,12 @@ def img_pool_layer(input, pool_size, stride=1, padding=0, pool_type=None,
         return L.pool2d(input=x, pool_size=pool_size, pool_type=ptype,
                         pool_stride=stride, pool_padding=padding)
 
+    c, h, w = _parent_geom(input, num_channels)
+    oh, ow = _out_hw(h, w, pool_size, stride, padding)
     lo = LayerOutput(name or _v2._uname("pool"), [input], build,
-                     size=input.size)
-    lo.num_channels = getattr(input, "num_channels", num_channels)
+                     size=(c * oh * ow) or input.size)
+    lo.num_channels = c
+    lo.img_shape = (None, oh, ow) if oh else None
     return _record(lo, "pool", pool_type=ptype)
 
 
@@ -799,6 +834,8 @@ def nce_layer(input, label, num_classes: int = None,
 
         helper = LayerHelper("nce", param_attr=param_attr,
                              bias_attr=bias_attr)
+        x = x.var if isinstance(x, SeqVal) else x
+        lab = lab.var if isinstance(lab, SeqVal) else lab
         d = input.size
         w = helper.create_parameter(param_attr, shape=[num_classes, d],
                                     dtype="float32")

@@ -109,6 +109,7 @@ def maxout_layer(input, groups: int, num_channels=None, name=None, **kw):
     c = num_channels or getattr(input, "num_channels", None)
     if c:
         lo.num_channels = c // groups
+    lo.img_shape = getattr(input, "img_shape", None)
     return lo
 
 

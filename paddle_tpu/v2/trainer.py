@@ -251,6 +251,8 @@ class SGD:
                               for k, v in feeder.feed(nxt[1]).items()}
                 if pending is not None:
                     pid, pcost = pending
+                    pending = None  # consume BEFORE emitting: a raising
+                    # handler must not see the event again from finally
                     event_handler(v2_event.EndIteration(
                         pass_id, pid,
                         float(np.asarray(pcost).reshape(-1)[0])))
