@@ -649,15 +649,21 @@ def slope_intercept_layer(input, slope: float = 1.0, intercept: float = 0.0,
 
 
 def interpolation_layer(input, weight, name=None, **kwargs):
-    """out = w * x1 + (1 - w) * x2 (reference InterpolationLayer)."""
+    """out = w * x1 + (1 - w) * x2, w a (B, 1) per-row weight
+    (reference InterpolationLayer: row-wise broadcast, axis 0)."""
     x1, x2 = input
 
     def build(ctx, w, a, b):
         from paddle_tpu import layers as L
 
-        return L.elementwise_add(L.elementwise_mul(a, w),
-                                 L.elementwise_mul(b, L.scale(w, scale=-1.0,
-                                                              bias=1.0)))
+        wv = w.var if isinstance(w, SeqVal) else w
+        av = a.var if isinstance(a, SeqVal) else a
+        bv = b.var if isinstance(b, SeqVal) else b
+        out = L.elementwise_add(
+            L.elementwise_mul(av, wv, axis=0),
+            L.elementwise_mul(bv, L.scale(wv, scale=-1.0, bias=1.0),
+                              axis=0))
+        return SeqVal(out, a.lengths) if isinstance(a, SeqVal) else out
 
     lo = LayerOutput(name or _v2._uname("interp"), [weight, x1, x2], build,
                      size=x1.size)
