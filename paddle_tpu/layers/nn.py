@@ -58,9 +58,16 @@ def fc(
     bias_attr=None,
     act: Optional[str] = None,
     name: Optional[str] = None,
+    in_features_hints=None,
     **kwargs,
 ):
+    """``in_features_hints`` (optional, per input): declared feature
+    size to use for the weight shape when the var's static feature dims
+    are unknown (e.g. after trans_layer swapped the batch dim in) —
+    the same fallback the reference takes from LayerConfig.size."""
     inputs_list = _to_list(input)
+    hints_list = (list(in_features_hints) if in_features_hints is not None
+                  else [None] * len(inputs_list))
     # per-input weight attrs (reference fc_layer accepts a list matched
     # to the input list)
     if isinstance(param_attr, (list, tuple)):
@@ -73,25 +80,29 @@ def fc(
                          act=act, name=name, **kwargs)
     dtype = inputs_list[0].dtype
     mul_results = []
-    for inp, param_attr in zip(inputs_list, attrs_list):
+    for inp, param_attr, hint in zip(inputs_list, attrs_list, hints_list):
         in_shape = inp.shape
-        if in_shape is None:
+        if in_shape is None and hint is None:
             raise ValueError(
                 f"fc input {inp.name!r} has no inferred shape; the weight "
                 "shape must be static")
-        lead = in_shape[num_flatten_dims:]
-        if any(s is None or s < 0 for s in lead):
-            raise ValueError(
-                f"fc input {inp.name!r} has unknown feature dims "
-                f"{tuple(lead)} past num_flatten_dims={num_flatten_dims}; "
-                "the weight shape must be static")
-        in_features = 1
-        for s in lead:
-            in_features *= s
+        lead = in_shape[num_flatten_dims:] if in_shape is not None else ()
+        if any(s is None or s < 0 for s in lead) or in_shape is None:
+            if hint is None:
+                raise ValueError(
+                    f"fc input {inp.name!r} has unknown feature dims "
+                    f"{tuple(lead)} past num_flatten_dims="
+                    f"{num_flatten_dims}; the weight shape must be static")
+            in_features = int(hint)
+        else:
+            in_features = 1
+            for s in lead:
+                in_features *= s
         w = helper.create_parameter(param_attr, shape=[in_features, size], dtype=dtype)
-        tmp = helper.create_tmp_variable(
-            dtype, tuple(in_shape[:num_flatten_dims]) + (size,), inp.lod_level
-        )
+        out_lead = (tuple(in_shape[:num_flatten_dims]) if in_shape is not None
+                    else (-1,) * num_flatten_dims)
+        tmp = helper.create_tmp_variable(dtype, out_lead + (size,),
+                                         inp.lod_level)
         helper.append_op(
             type="mul",
             inputs={"X": [inp], "Y": [w]},

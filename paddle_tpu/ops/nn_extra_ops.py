@@ -427,24 +427,35 @@ def _max_pool3d_with_index(ctx):
     ctx.set_output("Mask", (gd * H + gh) * W + gw)
 
 
-@register_op("block_expand", inputs=("X",))
+@register_op("block_expand", inputs=("X",), outputs=("Out", "OutLength"))
 def _block_expand(ctx):
     """im2col to sequence steps (reference: gserver BlockExpandLayer /
     function/BlockExpandOp.cpp): (B, C, H, W) -> (B, S, C*bh*bw) where
-    S = output positions, each step one block."""
+    S = output positions, each step one block.  OutLength (optional
+    slot) is the per-sample step count (all S — block positions are
+    dense), making the result a well-formed padded sequence."""
     x = unwrap(ctx.input("X"))
     bh, bw = int(ctx.attr("block_y")), int(ctx.attr("block_x"))
     sh = int(ctx.attr("stride_y", bh))
     sw = int(ctx.attr("stride_x", bw))
     ph = int(ctx.attr("padding_y", 0))
     pw = int(ctx.attr("padding_x", 0))
+    # the reference includes partial edge blocks (ceil output count:
+    # BlockExpandLayer.cpp outputH = 1 + (2p + img - block + s - 1)/s);
+    # pad bottom/right so the patch extractor emits exactly that many
+    H, W = x.shape[2], x.shape[3]
+    oh = (2 * ph + H - bh + sh - 1) // sh + 1
+    ow = (2 * pw + W - bw + sw - 1) // sw + 1
+    eh = max(0, (oh - 1) * sh + bh - H - 2 * ph)
+    ew = max(0, (ow - 1) * sw + bw - W - 2 * pw)
     patches = lax.conv_general_dilated_patches(
         x, filter_shape=(bh, bw), window_strides=(sh, sw),
-        padding=[(ph, ph), (pw, pw)],
+        padding=[(ph, ph + eh), (pw, pw + ew)],
         dimension_numbers=("NCHW", "OIHW", "NCHW"))
     B, CKK, OH, OW = patches.shape
     ctx.set_output("Out",
                    jnp.moveaxis(patches.reshape(B, CKK, OH * OW), 1, 2))
+    ctx.set_output("OutLength", jnp.full((B,), OH * OW, jnp.int32))
 
 
 @register_op("scale_sub_region_mask", inputs=("X", "Indices"))

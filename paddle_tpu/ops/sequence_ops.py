@@ -177,29 +177,128 @@ def _padded_sequence_pool(ctx):
     x = unwrap(ctx.input("X"))          # (B, T, D) or (B, T)
     lens = unwrap(ctx.input("Length")).reshape(-1).astype(jnp.int32)
     ptype = ctx.attr("pooltype", "AVERAGE").upper()
+    ptype = {"AVG": "AVERAGE"}.get(ptype, ptype)
     B, T = x.shape[0], x.shape[1]
     mask = (jnp.arange(T)[None, :] < lens[:, None])  # (B, T)
-    m = mask.reshape(mask.shape + (1,) * (x.ndim - 2)).astype(x.dtype)
-    if ptype == "SUM":
-        out = jnp.sum(x * m, axis=1)
-    elif ptype == "AVERAGE":
-        out = jnp.sum(x * m, axis=1) / jnp.maximum(
-            lens.astype(x.dtype), 1).reshape(-1, *([1] * (x.ndim - 2)))
-    elif ptype == "SQRT":
-        out = jnp.sum(x * m, axis=1) / jnp.sqrt(
-            jnp.maximum(lens.astype(x.dtype), 1)).reshape(-1, *([1] * (x.ndim - 2)))
-    elif ptype == "MAX":
-        neg = jnp.asarray(-1e9, x.dtype)
-        out = jnp.max(jnp.where(mask.reshape(m.shape).astype(bool), x, neg), axis=1)
-    elif ptype == "LAST":
+    if ptype == "LAST":
         idx = jnp.maximum(lens - 1, 0)
         out = jnp.take_along_axis(
             x, idx.reshape(-1, 1, *([1] * (x.ndim - 2))), axis=1)[:, 0]
     elif ptype == "FIRST":
         out = x[:, 0]
     else:
-        raise ValueError(ptype)
+        out = _masked_pool(x, mask, ptype, axis=1)
     ctx.set_output("Out", out)
+
+
+def _masked_pool(x, mask, ptype, axis):
+    """Pool ``x`` over ``axis`` under a boolean mask (same shape as x up
+    to trailing feature dims)."""
+    ptype = {"AVG": "AVERAGE"}.get(ptype, ptype)
+    m = mask.reshape(mask.shape + (1,) * (x.ndim - mask.ndim)).astype(x.dtype)
+    n = jnp.maximum(jnp.sum(m, axis=axis), 1.0)
+    if ptype == "SUM":
+        return jnp.sum(x * m, axis=axis)
+    if ptype == "AVERAGE":
+        return jnp.sum(x * m, axis=axis) / n
+    if ptype == "SQRT":
+        return jnp.sum(x * m, axis=axis) / jnp.sqrt(n)
+    if ptype == "MAX":
+        neg = jnp.asarray(-1e9, x.dtype)
+        return jnp.max(jnp.where(m.astype(bool), x, neg), axis=axis)
+    raise ValueError(ptype)
+
+
+@register_op("padded_subseq_pool", inputs=("X", "Length", "SubLength"),
+             diff_inputs=("X",))
+def _padded_subseq_pool(ctx):
+    """Pooling over a padded 2-level nested sequence (reference:
+    gserver/layers/SequencePoolLayer.cpp with trans_type="seq"/"non-seq"
+    over a nested input).  X (B, S, T, D), Length (B,) = #subsequences,
+    SubLength (B, S) = steps per subsequence.
+
+    agg="seq"  -> pool each subsequence:  (B, S, D)  (a plain sequence
+                  whose lengths are Length)
+    agg="none" -> pool every inner step:  (B, D)
+    """
+    x = unwrap(ctx.input("X"))                    # (B, S, T, ...)
+    outer = unwrap(ctx.input("Length")).reshape(-1).astype(jnp.int32)
+    sub = unwrap(ctx.input("SubLength")).astype(jnp.int32)  # (B, S)
+    ptype = ctx.attr("pooltype", "AVERAGE").upper()
+    agg = ctx.attr("agg", "seq")
+    B, S, T = x.shape[0], x.shape[1], x.shape[2]
+    # inner mask: step t of subseq s is real iff t < sub[b,s] AND s < outer[b]
+    s_real = (jnp.arange(S)[None, :] < outer[:, None])          # (B, S)
+    t_mask = (jnp.arange(T)[None, None, :] < sub[:, :, None])   # (B, S, T)
+    mask = jnp.logical_and(t_mask, s_real[:, :, None])
+    if agg == "seq":
+        out = _masked_pool(x, mask, ptype, axis=2)              # (B, S, ...)
+        ctx.set_output("Out", out)
+    else:
+        flat = x.reshape((B, S * T) + x.shape[3:])
+        out = _masked_pool(flat, mask.reshape(B, S * T), ptype, axis=1)
+        ctx.set_output("Out", out)
+
+
+@register_op("subseq_flatten", inputs=("X", "Length", "SubLength"),
+             outputs=("Out", "OutLength"), diff_inputs=("X",))
+def _subseq_flatten(ctx):
+    """Flatten a padded nested sequence (B, S, T, ...) to the packed
+    plain sequence view (B, S*T, ...) the reference's outer
+    sequenceStartPositions expose: real inner steps compacted to the
+    front (stable), lengths = total real steps per sample."""
+    x = unwrap(ctx.input("X"))
+    outer = unwrap(ctx.input("Length")).reshape(-1).astype(jnp.int32)
+    sub = unwrap(ctx.input("SubLength")).astype(jnp.int32)
+    B, S, T = x.shape[0], x.shape[1], x.shape[2]
+    s_real = (jnp.arange(S)[None, :] < outer[:, None])
+    mask = jnp.logical_and(
+        jnp.arange(T)[None, None, :] < sub[:, :, None],
+        s_real[:, :, None]).reshape(B, S * T)
+    # stable argsort of (not real) puts real steps first, in order
+    perm = jnp.argsort(~mask, axis=1, stable=True)
+    flat = x.reshape((B, S * T) + x.shape[3:])
+    out = jnp.take_along_axis(
+        flat, perm.reshape((B, S * T) + (1,) * (flat.ndim - 2)), axis=1)
+    ctx.set_output("Out", out)
+    ctx.set_output("OutLength", jnp.sum(mask.astype(jnp.int32), axis=1))
+
+
+@register_op("padded_sequence_stride_pool", inputs=("X", "Length"),
+             outputs=("Out", "OutLength"), diff_inputs=("X",))
+def _padded_sequence_stride_pool(ctx):
+    """Strided sequence pooling (reference: SequencePoolLayer stride_ —
+    pool each window of ``stride`` steps; output is a shorter sequence
+    of ceil(len/stride) window-pools)."""
+    x = unwrap(ctx.input("X"))          # (B, T, ...)
+    lens = unwrap(ctx.input("Length")).reshape(-1).astype(jnp.int32)
+    ptype = ctx.attr("pooltype", "AVERAGE").upper()
+    stride = int(ctx.attr("stride"))
+    B, T = x.shape[0], x.shape[1]
+    W = -(-T // stride)                 # windows
+    pad = W * stride - T
+    if pad:
+        x = jnp.pad(x, [(0, 0), (0, pad)] + [(0, 0)] * (x.ndim - 2))
+    xw = x.reshape((B, W, stride) + x.shape[2:])
+    tidx = jnp.arange(W * stride).reshape(W, stride)
+    mask = (tidx[None] < lens[:, None, None])       # (B, W, stride)
+    ctx.set_output("Out", _masked_pool(xw, mask, ptype, axis=2))
+    ctx.set_output("OutLength", -(-jnp.maximum(lens, 0) // stride))
+
+
+@register_op("padded_sequence_max_index", inputs=("X", "Length"),
+             stop_gradient=True)
+def _padded_sequence_max_index(ctx):
+    """Max pooling returning the argmax step index per feature
+    (reference: MaxPoolingType(output_max_index=True),
+    gserver/layers/MaxLayer.cpp IVector output)."""
+    x = unwrap(ctx.input("X"))          # (B, T, D)
+    lens = unwrap(ctx.input("Length")).reshape(-1).astype(jnp.int32)
+    mask = (jnp.arange(x.shape[1])[None, :] < lens[:, None])
+    m = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    neg = jnp.asarray(-1e9, x.dtype)
+    idx = jnp.argmax(jnp.where(m, x, neg), axis=1)
+    ctx.set_output("Out", idx.astype(jnp.float32))
 
 
 @register_op("lstm",
@@ -391,16 +490,55 @@ def _act_fn(name):
     }[name]
 
 
-@register_op("expand_as_steps", inputs=("X", "Y"), diff_inputs=("X",))
+@register_op("expand_as_steps", inputs=("X", "Y", "XLength"),
+             diff_inputs=("X",))
 def _expand_as_steps(ctx):
     """Broadcast a per-sequence vector X (B, D) to every step of the
     padded sequence Y (B, T, ...) -> (B, T, D) (reference analog:
     gserver ExpandLayer over LoD; here the batch is padded dense)."""
     x = unwrap(ctx.input("X"))
     y = unwrap(ctx.input("Y"))
+    poison = None
+    if x.ndim == 3:
+        # a length-1 sequence is dense data in the reference's contract
+        # (ExpandLayer.h: "sequence data where the length of each
+        # sequence is one" — it CHECK-fails otherwise).  Inside jit we
+        # cannot branch on data, so longer sequences poison the output
+        # with NaN, which the finite gates downstream turn loud.
+        if ctx.has_input("XLength"):
+            xlen = unwrap(ctx.input("XLength")).reshape(-1)
+            poison = jnp.max(xlen) > 1
+        x = x[:, 0]
     t = y.shape[1]
-    ctx.set_output("Out", jnp.broadcast_to(x[:, None, :],
-                                           (x.shape[0], t, x.shape[-1])))
+    out = jnp.broadcast_to(x[:, None, :], (x.shape[0], t, x.shape[-1]))
+    if poison is not None:
+        out = jnp.where(poison, jnp.nan, out)
+    ctx.set_output("Out", out)
+
+
+@register_op("expand_to_subseq", inputs=("X", "Y"), diff_inputs=("X",))
+def _expand_to_subseq(ctx):
+    """Expand into a padded nested sequence Y (B, S, T, ...) (reference:
+    gserver/layers/ExpandLayer.cpp with subSequenceStartPositions).
+    level="seq": X (B, S, D), step s broadcast over subsequence s's
+    inner steps; level="non-seq": X (B, D) broadcast over every inner
+    step."""
+    x = unwrap(ctx.input("X"))
+    y = unwrap(ctx.input("Y"))
+    B, S, T = y.shape[0], y.shape[1], y.shape[2]
+    if ctx.attr("level", "non-seq") == "seq":
+        # x's padded step count need not equal S (feeders bucket-pad);
+        # align it — steps past the real subsequence count are padding
+        if x.shape[1] >= S:
+            x = x[:, :S]
+        else:
+            x = jnp.pad(x, [(0, 0), (0, S - x.shape[1]), (0, 0)])
+        out = jnp.broadcast_to(x[:, :, None, :], (B, S, T, x.shape[-1]))
+    else:
+        if x.ndim == 3:
+            x = x[:, 0]
+        out = jnp.broadcast_to(x[:, None, None, :], (B, S, T, x.shape[-1]))
+    ctx.set_output("Out", out)
 
 
 @register_op("context_project", inputs=("X",))

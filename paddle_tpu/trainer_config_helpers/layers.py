@@ -315,10 +315,76 @@ def recurrent_layer(input, size=None, act=None, reverse=False, name=None,
 
 
 def pooling_layer(input, pooling_type: Optional[BasePoolingType] = None,
-                  name=None, **kwargs):
-    return _record(_v2.pooling(input=input,
-                               pooling_type=pooling_type or MaxPooling(),
-                               name=name), "seqpool")
+                  agg_level=None, stride: int = -1, name=None, **kwargs):
+    """Sequence pooling (reference: gserver/layers/SequencePoolLayer.cpp
+    + MaxLayer.cpp output_max_index).
+
+    - plain SeqVal input: pool over time; with ``stride`` > 0 pool each
+      window of stride steps instead (output stays a sequence);
+      MaxPooling(output_max_index=True) returns argmax step indices.
+    - SubSeqVal (nested) input: agg_level TO_SEQUENCE pools each
+      subsequence (output a plain sequence); TO_NO_SEQUENCE pools every
+      inner step to one vector.
+    """
+    pt = pooling_type or MaxPooling()
+    ptype = pt.name
+    max_index = bool(getattr(pt, "output_max_index", False))
+    to_seq = agg_level == "seq"
+
+    def build(ctx, v):
+        from paddle_tpu.layer_helper import LayerHelper
+        from paddle_tpu.v2.layer import SubSeqVal
+
+        if isinstance(v, SubSeqVal):
+            if not (max_index or (stride and stride > 0)):
+                agg = "seq" if to_seq else "none"
+                shape = ((-1, -1, input.size or 0) if to_seq
+                         else (-1, input.size or 0))
+                out = _op("padded_subseq_pool",
+                          {"X": [v.var], "Length": [v.lengths],
+                           "SubLength": [v.sub_lengths]},
+                          {"pooltype": ptype.upper(), "agg": agg},
+                          shape=shape)
+                return SeqVal(out, v.lengths) if to_seq else out
+            # stride / max-index pooling act on the outer sequence view:
+            # flatten the nested value to a packed plain sequence first
+            helper = LayerHelper("v1_subseq_flatten")
+            fv = helper.create_tmp_variable(
+                "float32", (-1, -1, input.size or 0))
+            fl = helper.create_tmp_variable("int32", (-1,))
+            helper.append_op(
+                type="subseq_flatten",
+                inputs={"X": [v.var], "Length": [v.lengths],
+                        "SubLength": [v.sub_lengths]},
+                outputs={"Out": [fv], "OutLength": [fl]})
+            v = SeqVal(fv, fl)
+        assert isinstance(v, SeqVal), "pooling expects a sequence input"
+        if max_index:
+            return _op("padded_sequence_max_index",
+                       {"X": [v.var], "Length": [v.lengths]},
+                       shape=(-1, input.size or 0))
+        if stride and stride > 0:
+            from paddle_tpu.layer_helper import LayerHelper
+
+            helper = LayerHelper("v1_stride_pool")
+            out = helper.create_tmp_variable(
+                "float32", (-1, -1, input.size or 0))
+            lens = helper.create_tmp_variable("int32", (-1,))
+            helper.append_op(
+                type="padded_sequence_stride_pool",
+                inputs={"X": [v.var], "Length": [v.lengths]},
+                outputs={"Out": [out], "OutLength": [lens]},
+                attrs={"pooltype": ptype.upper(), "stride": int(stride)})
+            return SeqVal(out, lens)
+        return _op("padded_sequence_pool",
+                   {"X": [v.var], "Length": [v.lengths]},
+                   {"pooltype": ptype.upper()},
+                   shape=(-1, input.size or 0))
+
+    is_seq_out = to_seq or (stride and stride > 0 and not max_index)
+    lo = LayerOutput(name or _v2._uname("seqpool"), [input], build,
+                     size=input.size, is_seq=bool(is_seq_out))
+    return _record(lo, "seqpool")
 
 
 def last_seq(input, name=None, **kwargs):
@@ -329,15 +395,41 @@ def first_seq(input, name=None, **kwargs):
     return _record(_v2.first_seq(input=input, name=name), "seqfirstins")
 
 
-def expand_layer(input, expand_as, name=None, **kwargs):
-    """Broadcast a per-sequence vector to every step of ``expand_as``
-    (reference ExpandLayer)."""
+def expand_layer(input, expand_as, expand_level="non-seq", name=None,
+                 **kwargs):
+    """Broadcast per-sequence data to every step of ``expand_as``
+    (reference gserver/layers/ExpandLayer.cpp).
+
+    - ``expand_as`` plain sequence: input is dense (one row per
+      sequence), broadcast over its steps (FROM_NO_SEQUENCE).
+    - ``expand_as`` nested: FROM_SEQUENCE broadcasts input step ``s``
+      (one per subsequence) over that subsequence's inner steps;
+      FROM_NO_SEQUENCE broadcasts the per-sample row over every inner
+      step.  Output carries ``expand_as``'s nesting, exactly as the
+      reference copies the shape input's (sub)sequence positions.
+    """
 
     def build(ctx, x, seq):
+        from paddle_tpu.v2.layer import SubSeqVal
+
+        if expand_level == "seq" and not isinstance(x, SeqVal):
+            raise ValueError(
+                "expand_layer(expand_level=FROM_SEQUENCE) requires a "
+                "sequence input (the reference ExpandLayer CHECK-fails "
+                "on a dense one)")
+        if isinstance(seq, SubSeqVal):
+            xv = x.var if isinstance(x, SeqVal) else x
+            level = "seq" if expand_level == "seq" else "non-seq"
+            out = _op("expand_to_subseq", {"X": [xv], "Y": [seq.var]},
+                      {"level": level},
+                      shape=(-1, -1, -1, input.size or 0))
+            return SubSeqVal(out, seq.lengths, seq.sub_lengths)
         assert isinstance(seq, SeqVal)
-        xv = x.var if isinstance(x, SeqVal) else x
-        out = _op("expand_as_steps", {"X": [xv], "Y": [seq.var]},
-                  shape=(-1, -1, input.size or 0))
+        ins = {"X": [x.var if isinstance(x, SeqVal) else x],
+               "Y": [seq.var]}
+        if isinstance(x, SeqVal):
+            ins["XLength"] = [x.lengths]
+        out = _op("expand_as_steps", ins, shape=(-1, -1, input.size or 0))
         return SeqVal(out, seq.lengths)
 
     lo = LayerOutput(name or _v2._uname("expand"), [input, expand_as], build,

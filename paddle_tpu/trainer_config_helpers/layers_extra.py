@@ -102,14 +102,24 @@ def _as_image(x, parent, num_channels, want_depth=False):
 def maxout_layer(input, groups: int, num_channels=None, name=None, **kw):
     def build(ctx, x):
         xi = _as_image(x, input, num_channels)
-        return _op("maxout", {"X": [xi]}, {"groups": int(groups)})
+        shp = getattr(xi, "shape", None)
+        out_shape = None
+        if shp is not None and len(shp) == 4:
+            c = shp[1]
+            out_shape = (shp[0], c // groups if c and c > 0 else c,
+                         shp[2], shp[3])
+        return _op("maxout", {"X": [xi]}, {"groups": int(groups)},
+                   shape=out_shape)
 
     lo = _simple("maxout", [input], build,
                  size=(input.size or 0) // groups, name=name)
     c = num_channels or getattr(input, "num_channels", None)
     if c:
         lo.num_channels = c // groups
-    lo.img_shape = getattr(input, "img_shape", None)
+    img = getattr(input, "img_shape", None)
+    if img and c:
+        img = (c // groups,) + tuple(img[1:])
+    lo.img_shape = img
     return lo
 
 
@@ -976,15 +986,44 @@ def block_expand_layer(input, block_x, block_y, stride_x=None, stride_y=None,
                        padding_x=0, padding_y=0, num_channels=None,
                        name=None, **kw):
     """im2col: expand conv blocks into sequence steps (reference
-    BlockExpandLayer; op: context of conv_general_dilated_patches)."""
-    def build(ctx, x):
-        return _op("block_expand", {"X": [_unwrap(x)]},
-                   {"block_y": int(block_y), "block_x": int(block_x),
-                    "stride_y": int(stride_y or block_y),
-                    "stride_x": int(stride_x or block_x),
-                    "padding_y": int(padding_y), "padding_x": int(padding_x)})
+    BlockExpandLayer, gserver/layers/BlockExpandLayer.cpp — its output
+    IS a sequence: one step per block position, step size C*bh*bw).
+    Op: conv_general_dilated_patches; the OutLength side output carries
+    the (static) per-sample step count so downstream sequence layers
+    see a SeqVal."""
+    bh, bw = int(block_y), int(block_x)
+    sh, sw = int(stride_y or block_y), int(stride_x or block_x)
+    ph, pw = int(padding_y), int(padding_x)
 
-    return _simple("block_expand", [input], build, name=name)
+    def build(ctx, x):
+        from paddle_tpu.layer_helper import LayerHelper
+
+        xi = _as_image(x, input, num_channels)
+        shp = getattr(xi, "shape", None)
+        out_shape = None
+        if shp is not None and len(shp) == 4 and all(
+                s and s > 0 for s in shp[1:]):
+            c, h, w = shp[1:]
+            # ceil block count, as the reference computes it
+            # (BlockExpandLayer.cpp: 1 + (2p + img - block + stride - 1)
+            # / stride) — partial edge blocks are included
+            oh = (2 * ph + h - bh + sh - 1) // sh + 1
+            ow = (2 * pw + w - bw + sw - 1) // sw + 1
+            out_shape = (shp[0], oh * ow, c * bh * bw)
+        helper = LayerHelper("v1_block_expand")
+        out = helper.create_tmp_variable("float32", out_shape)
+        lens = helper.create_tmp_variable("int32", (-1,))
+        helper.append_op(
+            type="block_expand", inputs={"X": [xi]},
+            outputs={"Out": [out], "OutLength": [lens]},
+            attrs={"block_y": bh, "block_x": bw, "stride_y": sh,
+                   "stride_x": sw, "padding_y": ph, "padding_x": pw})
+        return SeqVal(out, lens)
+
+    c = num_channels or getattr(input, "num_channels", None)
+    return _simple("block_expand", [input], build,
+                   size=(c * bh * bw) if c else None, is_seq=True,
+                   type_="blockexpand", name=name)
 
 
 def sub_seq_layer(input, offsets, sizes, name=None, **kw):

@@ -145,18 +145,25 @@ def fc(input, size: int, act=None, param_attr=None, bias_attr=None,
         outs = []
         seq_len = None
         fluid_ins = []
+        hints = []
         flatten = 1
-        for v in vals:
+        for v, lo in zip(vals, inputs):
             if isinstance(v, SeqVal):
                 fluid_ins.append(v.var)
+                hints.append(lo.size)
                 seq_len = v.lengths
                 flatten = 2
             else:
+                # when a var lost its static feature dim (e.g.
+                # trans_layer swapped the batch dim in), the declared
+                # v1 layer size is the weight-shape fallback — the same
+                # thing the reference's LayerConfig.size is
                 fluid_ins.append(v)
+                hints.append(lo.size)
         out = L.fc(input=fluid_ins if len(fluid_ins) > 1 else fluid_ins[0],
                    size=size, num_flatten_dims=flatten,
                    param_attr=param_attr, bias_attr=bias_attr,
-                   act=_act_name(act))
+                   act=_act_name(act), in_features_hints=hints)
         return SeqVal(out, seq_len) if seq_len is not None else out
 
     any_seq = any(getattr(i, "is_seq", False) for i in inputs)
