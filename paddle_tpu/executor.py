@@ -179,7 +179,8 @@ class _Compiled:
 
 
 _RANDOM_OPS = frozenset(
-    {"uniform_random", "gaussian_random", "dropout", "sampling_id", "random_crop"}
+    {"uniform_random", "gaussian_random", "dropout", "sampling_id",
+     "random_crop", "nce"}
 )
 
 

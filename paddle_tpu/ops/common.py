@@ -21,12 +21,16 @@ def broadcast_to_x(x, y, axis: int = -1):
     y_ = unwrap(y)
     if x_.shape == y_.shape:
         return y_
+    # the default axis aligns Y's ORIGINAL rank to X's trailing dims
+    # (reference operators/elementwise_op.h: axis = x.ndim - y.ndim,
+    # computed before the trailing-1 trim), so (B,1) against (B,D)
+    # anchors at axis 0, not at the feature dim
+    if axis == -1:
+        axis = x_.ndim - y_.ndim
     # trim trailing 1s from y (reference trims them before matching)
     yshape = list(y_.shape)
     while yshape and yshape[-1] == 1 and len(yshape) > 1:
         yshape = yshape[:-1]
-    if axis == -1:
-        axis = x_.ndim - len(yshape)
     full = [1] * x_.ndim
     for i, s in enumerate(yshape):
         full[axis + i] = s

@@ -153,6 +153,20 @@ def _squared_l2_distance(ctx):
 def _cos_sim(ctx):
     x = unwrap(ctx.input("X"))
     y = unwrap(ctx.input("Y"))
+    if y.shape[-1] != x.shape[-1]:
+        # reference CosSimLayer size>1: Y holds K stacked vectors of
+        # X's width; output is the K similarities (gserver
+        # CosSimLayer.cpp with config size = K)
+        k = y.shape[-1] // x.shape[-1]
+        y = y.reshape(y.shape[:-1] + (k, x.shape[-1]))
+        x = x[..., None, :]
+        xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1))
+        yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=-1))
+        out = jnp.sum(x * y, axis=-1) / (xn * yn + 1e-12)
+        ctx.set_output("Out", out)
+        ctx.set_output("XNorm", xn)
+        ctx.set_output("YNorm", yn)
+        return
     xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
     yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=-1, keepdims=True))
     out = jnp.sum(x * y, axis=-1, keepdims=True) / (xn * yn + 1e-12)

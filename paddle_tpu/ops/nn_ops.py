@@ -84,13 +84,17 @@ def _conv2d_transpose(ctx):
     strides = _pair(ctx.attr("strides", (1, 1)))
     pads = _pair(ctx.attr("paddings", (0, 0)))
     dilations = _pair(ctx.attr("dilations", (1, 1)))
+    # paddle filter layout (Cin, Cout, H, W) is the OIHW layout of the
+    # forward conv being transposed, which is exactly what
+    # transpose_kernel=True expects (it swaps I/O and flips spatials);
+    # declaring it IOHW only type-checked when Cin == Cout
     out = lax.conv_transpose(
         x,
         w,
         strides=strides,
         padding=[(pads[0], pads[0]), (pads[1], pads[1])],
         rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
         transpose_kernel=True,
     ).astype(x.dtype)
     ctx.set_output("Output", out)
@@ -300,11 +304,12 @@ def _conv3d_transpose(ctx):
     strides = tuple(ctx.attr("strides", (1, 1, 1)))
     pads = tuple(ctx.attr("paddings", (0, 0, 0)))
     dilations = tuple(ctx.attr("dilations", (1, 1, 1)))
+    # (Cin, Cout, D, H, W) = the forward conv's OIDHW; see the 2-D twin
     out = lax.conv_transpose(
         x, w, strides=strides,
         padding=[(p, p) for p in pads],
         rhs_dilation=dilations,
-        dimension_numbers=("NCDHW", "IODHW", "NCDHW"),
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
         transpose_kernel=True,
     ).astype(x.dtype)
     ctx.set_output("Output", out)

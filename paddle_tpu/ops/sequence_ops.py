@@ -264,6 +264,49 @@ def _subseq_flatten(ctx):
     ctx.set_output("OutLength", jnp.sum(mask.astype(jnp.int32), axis=1))
 
 
+@register_op("padded_sequence_multi_slice",
+             inputs=("X", "Length", "Starts", "Ends"),
+             outputs=("Out", "OutLength", "OutSubLength"),
+             diff_inputs=("X",))
+def _padded_sequence_multi_slice(ctx):
+    """K slices out of each sequence (reference:
+    gserver/layers/SeqSliceLayer.cpp — starts/ends are (B, K), each row
+    selects K windows, and the output is K sequences per input, i.e. a
+    nested sequence).  X (B, T, D) -> Out (B, K, T, D) with
+    OutSubLength (B, K) = clamped end-start and OutLength (B,) = K."""
+    x = unwrap(ctx.input("X"))
+    lens = unwrap(ctx.input("Length")).reshape(x.shape[0], -1)[:, 0] \
+        if unwrap(ctx.input("Length")).ndim > 1 else \
+        unwrap(ctx.input("Length")).reshape(-1)
+    lens = lens.astype(jnp.int32)
+    B, T = x.shape[0], x.shape[1]
+    if ctx.has_input("Starts"):
+        starts = unwrap(ctx.input("Starts")).astype(jnp.int32)
+    else:
+        starts = None
+    if ctx.has_input("Ends"):
+        ends = unwrap(ctx.input("Ends")).astype(jnp.int32)
+    else:
+        ends = None
+    if starts is None:
+        starts = jnp.zeros_like(ends)
+    if ends is None:
+        ends = jnp.broadcast_to(lens[:, None], starts.shape)
+    K = starts.shape[1]
+    starts = jnp.clip(starts, 0, lens[:, None])
+    ends = jnp.clip(ends, starts, lens[:, None])
+    sub_len = ends - starts                                   # (B, K)
+    t = jnp.arange(T)[None, None, :]                          # (1, 1, T)
+    idx = jnp.clip(starts[:, :, None] + t, 0, T - 1)          # (B, K, T)
+    gathered = jnp.take_along_axis(
+        x[:, None], idx.reshape(B, K, T, *([1] * (x.ndim - 2))), axis=2)
+    mask = (t < sub_len[:, :, None]).reshape(
+        (B, K, T) + (1,) * (x.ndim - 2))
+    ctx.set_output("Out", jnp.where(mask, gathered, 0))
+    ctx.set_output("OutLength", jnp.full((B,), K, jnp.int32))
+    ctx.set_output("OutSubLength", sub_len)
+
+
 @register_op("padded_sequence_stride_pool", inputs=("X", "Length"),
              outputs=("Out", "OutLength"), diff_inputs=("X",))
 def _padded_sequence_stride_pool(ctx):

@@ -608,10 +608,17 @@ def seq_concat_layer(a, b, name=None, **kw):
 
 def seq_slice_layer(input, starts=None, ends=None, name=None, **kw):
     """Slice [starts, ends) out of each sequence (reference
-    SeqSliceLayer) via the padded_sequence_slice op."""
+    gserver/layers/SeqSliceLayer.cpp).  With K-column starts/ends each
+    sequence yields K windows — a nested sequence output, matching the
+    reference's multi-subsequence selection; with scalar columns the
+    single-window padded_sequence_slice path applies."""
+    multi = ((starts is not None and (starts.size or 1) > 1)
+             or (ends is not None and (ends.size or 1) > 1))
+
     def build(ctx, x, *rest):
         from paddle_tpu import layers as L
         from paddle_tpu.layer_helper import LayerHelper
+        from paddle_tpu.v2.layer import SubSeqVal
 
         assert isinstance(x, SeqVal)
         k = 0
@@ -621,6 +628,21 @@ def seq_slice_layer(input, starts=None, ends=None, name=None, **kw):
         if ends is not None:
             ev = _unwrap(rest[k]); k += 1
         helper = LayerHelper("seq_slice")
+        if multi:
+            out = helper.create_tmp_variable(
+                "float32", (-1, -1, -1, input.size or 0))
+            olen = helper.create_tmp_variable("int32", (-1,))
+            oslen = helper.create_tmp_variable("int32", (-1, -1))
+            ins = {"X": [x.var], "Length": [x.lengths]}
+            if sv is not None:
+                ins["Starts"] = [sv]
+            if ev is not None:
+                ins["Ends"] = [ev]
+            helper.append_op(
+                type="padded_sequence_multi_slice", inputs=ins,
+                outputs={"Out": [out], "OutLength": [olen],
+                         "OutSubLength": [oslen]})
+            return SubSeqVal(out, olen, oslen)
         if sv is None:
             sv = _op("fill_constant_batch_size_like",
                      {"Input": [x.lengths]},
@@ -802,10 +824,10 @@ def layer_support(*attrs):
     return deco
 
 
-def square_error_cost(input, label, name=None, **kw):
+def square_error_cost(input, label, weight=None, name=None, **kw):
     from paddle_tpu.trainer_config_helpers.layers import mse_cost
 
-    return mse_cost(input=input, label=label, name=name)
+    return mse_cost(input=input, label=label, weight=weight, name=name)
 
 
 # -- projections / operators for mixed_layer ---------------------------------

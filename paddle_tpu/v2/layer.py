@@ -142,29 +142,54 @@ def fc(input, size: int, act=None, param_attr=None, bias_attr=None,
     def build(ctx, *vals):
         from paddle_tpu import layers as L
 
-        outs = []
         seq_len = None
-        fluid_ins = []
-        hints = []
-        flatten = 1
+        fluid_ins = []   # (var, num_flatten_dims, size_hint)
+        any_seq_in = False
         for v, lo in zip(vals, inputs):
             if isinstance(v, SeqVal):
-                fluid_ins.append(v.var)
-                hints.append(lo.size)
+                # the declared v1 layer size is the weight-shape
+                # fallback when a var lost its static feature dim (the
+                # same thing the reference's LayerConfig.size is)
+                fluid_ins.append((v.var, 2, lo.size))
                 seq_len = v.lengths
-                flatten = 2
+                any_seq_in = True
             else:
-                # when a var lost its static feature dim (e.g.
-                # trans_layer swapped the batch dim in), the declared
-                # v1 layer size is the weight-shape fallback — the same
-                # thing the reference's LayerConfig.size is
-                fluid_ins.append(v)
-                hints.append(lo.size)
-        out = L.fc(input=fluid_ins if len(fluid_ins) > 1 else fluid_ins[0],
-                   size=size, num_flatten_dims=flatten,
-                   param_attr=param_attr, bias_attr=bias_attr,
-                   act=_act_name(act), in_features_hints=hints)
-        return SeqVal(out, seq_len) if seq_len is not None else out
+                shp = getattr(v, "shape", None)
+                nf = 2 if (shp is not None and len(shp) == 3) else 1
+                any_seq_in = any_seq_in or nf == 2
+                fluid_ins.append((v, nf, lo.size))
+        if len(fluid_ins) == 1 or all(nf == fluid_ins[0][1]
+                                      for _, nf, _ in fluid_ins):
+            out = L.fc(input=[v for v, _, _ in fluid_ins]
+                       if len(fluid_ins) > 1 else fluid_ins[0][0],
+                       size=size, num_flatten_dims=fluid_ins[0][1],
+                       param_attr=param_attr, bias_attr=bias_attr,
+                       act=_act_name(act),
+                       in_features_hints=[h for _, _, h in fluid_ins])
+            return SeqVal(out, seq_len) if seq_len is not None else out
+        # mixed sequence + per-sequence inputs (e.g. a step sequence
+        # plus a recurrent memory inside a nested group): project each
+        # with its own flatten depth, broadcast the dense terms over
+        # time, then apply bias/activation once
+        total = None
+        for i, (v, nf, hint) in enumerate(fluid_ins):
+            pa = (param_attr[i] if isinstance(param_attr, (list, tuple))
+                  else param_attr)
+            part = L.fc(input=v, size=size, num_flatten_dims=nf,
+                        param_attr=pa, bias_attr=False, act=None,
+                        in_features_hints=[hint])
+            if any_seq_in and nf == 1:
+                part = L.reshape(part, shape=[0, 1, size])
+            total = part if total is None else L.elementwise_add(total, part)
+        if bias_attr is not False:
+            from paddle_tpu.layer_helper import LayerHelper
+
+            helper = LayerHelper("v2_fc_bias", bias_attr=bias_attr)
+            total = helper.append_bias_op(total, dim_start=2)
+        a = _act_name(act)
+        if a:
+            total = getattr(L, a)(total)
+        return SeqVal(total, seq_len) if seq_len is not None else total
 
     any_seq = any(getattr(i, "is_seq", False) for i in inputs)
     return LayerOutput(name or _uname("fc"), list(inputs), build, size=size,

@@ -51,24 +51,12 @@ PARSE_ONLY = {
         "name; capture still validated up to the error",
     "test_cost_layers.py":
         "nce over a sequence-typed hidden (feed-synthesis limitation)",
-    "test_cost_layers_with_weight.py":
-        "weighted-cost broadcasting needs per-cost weight slots",
     "test_cross_entropy_over_beam.py":
         "beam CE consumes raw nested-seq wrappers",
-    "test_deconv3d_layer.py":
-        "transposed-conv3d filter group shape mismatch",
     "test_detection_output_layer.py":
         "detection feeds need box-shaped synthesized inputs",
     "test_multibox_loss_layer.py":
         "multibox needs prior-box shaped feeds",
-    "test_ntm_layers.py":
-        "per-row weighted ops on mixed seq/dense operands",
-    "test_rnn_group.py":
-        "nested recurrent_group over SubsequenceInput",
-    "test_seq_slice_layer.py":
-        "per-sequence starts/ends slice feed synthesis",
-    "test_sub_nested_seq_select_layer.py":
-        "nested-seq select output re-wrapping",
 }
 
 # per-config feed-kind overrides where a data layer's sequence level
@@ -81,6 +69,13 @@ PARSE_ONLY = {
 FEED_KIND = {
     "test_sequence_pooling.py": {"dat_in": "nested"},
     "test_expand_layer.py": {"data": "seq1", "data_seq": "nested1"},
+    # SubsequenceInput group iterates subsequences (reference:
+    # RecurrentGradientMachine.cpp:530, sequence_nest_rnn.conf)
+    "test_rnn_group.py": {"sub_seq_input": "nested"},
+    # only input[0] of seq_slice is a sequence; starts/ends are (B, K)
+    "test_seq_slice_layer.py": {"starts": "dense", "ends": "dense"},
+    # selected_indices of sub_nested_seq is a dense (B, beam) id matrix
+    "test_sub_nested_seq_select_layer.py": {"input": "dense"},
 }
 
 # per-config batch-size overrides: trans_layer transposes the minibatch
@@ -93,6 +88,7 @@ SEQ_CONSUMERS = {
     "seqlastins", "seqfirstins", "seq_pool", "pooling", "seq_concat",
     "seq_reshape", "seq_slice", "kmax_seq_score", "sub_seq",
     "sub_nested_seq", "expand", "lstmemory", "grumemory", "recurrent",
+    "recurrent_group",
     "row_conv", "ctc", "warp_ctc", "gated_recurrent", "seq_last",
     "seq_first", "max_id_seq", "crf", "seqtext_printer",
 }
@@ -213,7 +209,8 @@ def _run_config(fn, T=8, B=4):
         size = lo.size or 1
         kind = kinds.get(name)
         if kind is not None:
-            lo.input_type = (dt.dense_vector_sub_sequence(size)
+            lo.input_type = (dt.dense_vector(size) if kind == "dense"
+                             else dt.dense_vector_sub_sequence(size)
                              if kind.startswith("nested")
                              else dt.dense_vector_sequence(size))
         elif name in nested_names:

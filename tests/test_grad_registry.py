@@ -422,6 +422,34 @@ SPECS = {
                 "SliceLen": [("s", np.array([2, 2], np.int64))]},
         attrs={}, output_slots=["Out", "OutLength"], wrt=["x"],
         loss_slot="Out"),
+    "expand_to_subseq": lambda: dict(
+        inputs={"X": [("x", U((2, 3)))],
+                "Y": [("y", U((2, 2, 4, 3), seed=1))]},
+        attrs={"level": "non-seq"}, output_slots=["Out"], wrt=["x"]),
+    "padded_subseq_pool": lambda: dict(
+        inputs={"X": [("x", U((2, 2, 3, 2)))],
+                "Length": [("l", np.array([2, 1], np.int64))],
+                "SubLength": [("s", np.array([[3, 2], [2, 0]], np.int64))]},
+        attrs={"pooltype": "AVERAGE", "agg": "seq"},
+        output_slots=["Out"], wrt=["x"]),
+    "padded_sequence_stride_pool": lambda: dict(
+        inputs={"X": [("x", U((2, 5, 2)))],
+                "Length": [("l", np.array([5, 3], np.int64))]},
+        attrs={"pooltype": "AVERAGE", "stride": 2},
+        output_slots=["Out", "OutLength"], wrt=["x"], loss_slot="Out"),
+    "subseq_flatten": lambda: dict(
+        inputs={"X": [("x", U((2, 2, 3, 2)))],
+                "Length": [("l", np.array([2, 1], np.int64))],
+                "SubLength": [("s", np.array([[3, 2], [2, 0]], np.int64))]},
+        attrs={}, output_slots=["Out", "OutLength"], wrt=["x"],
+        loss_slot="Out"),
+    "padded_sequence_multi_slice": lambda: dict(
+        inputs={"X": [("x", U((2, 4, 2)))],
+                "Length": [("l", np.array([4, 3], np.int64))],
+                "Starts": [("st", np.array([[0, 1], [1, 0]], np.int64))],
+                "Ends": [("en", np.array([[2, 3], [3, 2]], np.int64))]},
+        attrs={}, output_slots=["Out", "OutLength", "OutSubLength"],
+        wrt=["x"], loss_slot="Out"),
 }
 
 
