@@ -1,23 +1,29 @@
 """Per-shape XLA conv emitter probe at the ResNet-50 BS=256 hot shapes.
 
-PERF.md's trace decomposition shows the framework ResNet step is bound
-by the conv emitters (fwd ~48 TF, bwd-input ~31 TF, bwd-filter ~45 TF
-of a measured 132 TF matmul roofline).  This probe times each dominant
-conv shape in isolation — forward, backward-input, backward-filter —
-so a Pallas implicit-GEMM kernel has a per-shape target to beat.
+Methodology (round-4 correction): this chip's tunnel adds ~20 ms of
+FIXED per-program overhead on top of the 2.4-5.7 ms dispatch floor —
+a 4096^3 bf16 matmul chain measures 38 TF/s at R=8 chained
+applications but 126 TF/s at R=64.  Every measurement here therefore
+value-chains R=64 applications inside one jit and reads a single
+scalar:
 
-Tunnel-aware methodology (PERF.md): the per-dispatch floor is
-2.4-5.7 ms and D2H runs ~30 MB/s, so each measurement runs R
-dependency-chained iterations inside ONE jitted program and transfers
-only a scalar.  The chain dependency is data-dependent
-(where(isnan(s), s, 0)) so XLA can neither fold it away nor CSE the
-iterations.  bf16 IO, f32 accumulation, NHWC (the amp model layout).
+- square stride-1 convs (Cin == Cout) chain directly: y = conv(y, w);
+- expand/reduce 1x1 pairs chain as alternating pairs (C -> 4C -> C),
+  reporting the pair average.
 
-Usage: python benchmark/conv_probe.py [--steps N] [--inner R]
+The stride-2 downsample/stem shapes are not probed here (no
+shape-preserving chain exists for them); they stay on the XLA emitter
+unconditionally.
+
+The earlier revision of this file dep-chained with R=8 and read
+5-16 TF/s for every shape; those numbers were fixed-overhead
+artifacts, not emitter efficiency (PERF.md "Round-4 conv kernel
+verdict").
+
+Usage: python benchmark/conv_probe.py [--steps N] [--only c2,c4]
 """
 
 import argparse
-import functools
 import time
 
 import jax
@@ -25,98 +31,80 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-# (name, N, H, W, Cin, Cout, k, stride) — the shapes carrying ResNet-50
-# BS=256's conv FLOPs (each 3x3 row repeats 3-6x per step, fwd + 2 bwd)
-SHAPES = [
+R = 64
+
+# (name, N, H, W, Cin, Cout, k, stride)
+SQUARE = [
     ("c2.3x3", 256, 56, 56, 64, 64, 3, 1),
     ("c3.3x3", 256, 28, 28, 128, 128, 3, 1),
     ("c4.3x3", 256, 14, 14, 256, 256, 3, 1),
     ("c5.3x3", 256, 7, 7, 512, 512, 3, 1),
-    ("c2.1x1x4", 256, 56, 56, 64, 256, 1, 1),
-    ("c4.1x1x4", 256, 14, 14, 256, 1024, 1, 1),
-    ("c3.down", 256, 56, 56, 256, 512, 1, 2),
-    ("stem.7x7", 256, 224, 224, 3, 64, 7, 2),
+]
+PAIRS = [  # 1x1 expand/reduce bottleneck pairs
+    ("c2.1x1", 256, 56, 56, 64, 256),
+    ("c3.1x1", 256, 28, 28, 128, 512),
+    ("c4.1x1", 256, 14, 14, 256, 1024),
 ]
 
 
-def conv(x, w, stride):
-    # plain bf16 conv (the MXU accumulates f32 internally); grad
-    # through preferred_element_type=f32 trips a dtype check in the
-    # conv transpose rule, and the model path convolves bf16->bf16
-    return jax.lax.conv_general_dilated(
-        x, w, window_strides=(stride, stride), padding="SAME",
+def conv(x, w, stride=1):
+    return lax.conv_general_dilated(
+        x, w, (stride, stride), "SAME",
         dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
-def chain(fn, x, r):
-    """Run fn(x_i) R times with an unfoldable data dependency between
-    iterations; returns a scalar."""
-
-    def body(_, carry):
-        x_c, acc = carry
-        s = jnp.sum(fn(x_c).astype(jnp.float32))
-        dep = jnp.where(jnp.isnan(s), s, 0.0).astype(x.dtype)
-        return x + dep, acc + s
-
-    _, acc = lax.fori_loop(0, r, body, (x, jnp.float32(0)))
-    return acc
-
-
-def time_scalar(fn, steps):
-    out = float(fn())  # compile + warm
+def timed(jf, arg, steps, napps):
+    out = float(jf(arg))
     t0 = time.perf_counter()
     for _ in range(steps):
-        out = fn()
+        out = jf(arg)
     float(out)
-    return (time.perf_counter() - t0) / steps
+    return (time.perf_counter() - t0) / steps / napps
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=3)
-    ap.add_argument("--inner", type=int, default=8)
-    ap.add_argument("--only", type=str, default="",
-                    help="comma-separated shape-name substrings")
+    ap.add_argument("--only", type=str, default="")
     args = ap.parse_args()
-    R = args.inner
-    rng = np.random.RandomState(0)
     only = [t for t in args.only.split(",") if t]
-    print(f"{'shape':10} {'dir':6} {'ms':>8} {'TF/s':>7}", flush=True)
-    for name, n, h, w, ci, co, k, s in SHAPES:
+    rng = np.random.RandomState(0)
+    print(f"{'shape':10} {'ms':>8} {'TF/s':>7}", flush=True)
+
+    for name, n, h, w, ci, co, k, s in SQUARE:
         if only and not any(t in name for t in only):
             continue
         x = jnp.asarray(rng.randn(n, h, w, ci), jnp.bfloat16)
-        wt = jnp.asarray(rng.randn(k, k, ci, co) * 0.05, jnp.bfloat16)
-        oh, ow = -(-h // s), -(-w // s)
-        flops = 2 * n * oh * ow * ci * co * k * k
-        g = jnp.asarray(rng.randn(n, oh, ow, co) * 0.05, jnp.bfloat16)
+        wt = jnp.asarray(rng.randn(k, k, ci, co) * 0.03, jnp.bfloat16)
+        flops = 2 * n * h * w * ci * co * k * k
 
-        def loss_x(xx, ww, gg):
-            return jnp.sum(conv(xx, ww, s).astype(jnp.float32) *
-                           gg.astype(jnp.float32))
+        def run(x0, wt=wt, s=s):
+            def body(_, y):
+                return conv(y, wt, s)
 
-        # each direction chains on an operand its output DEPENDS on
-        # (dx is linear: independent of x; dw independent of w) so the
-        # loop body cannot be hoisted as loop-invariant
-        fwd = jax.jit(lambda xx: chain(lambda v: conv(v, wt, s), xx, R))
-        bwd_x = jax.jit(lambda gg: chain(
-            lambda v: jax.grad(loss_x, argnums=0)(x, wt, v), gg, R))
-        bwd_w = jax.jit(lambda xx: chain(
-            lambda v: jax.grad(loss_x, argnums=1)(v, wt, g), xx, R))
-        for tag, fn, arg in (("fwd", fwd, x), ("bwd_x", bwd_x, g),
-                             ("bwd_w", bwd_w, x)):
-            # the harness itself costs a sum + a dep-add pass per
-            # iteration (measured: it caps a 132TF 4096^3 matmul at
-            # ~38TF) — subtract an identity-chain baseline on the same
-            # argument so the reported net time is the op alone
-            ov_fn = jax.jit(lambda aa: chain(lambda v: v, aa, R))
-            dt_ov = time_scalar(functools.partial(ov_fn, arg),
-                                args.steps) / R
-            dt = time_scalar(functools.partial(fn, arg), args.steps) / R
-            net = max(dt - dt_ov, 1e-9)
-            print(f"{name:10} {tag:6} {net*1e3:8.2f} "
-                  f"{flops/net/1e12:7.1f}  (raw {dt*1e3:.2f} "
-                  f"ov {dt_ov*1e3:.2f})", flush=True)
+            return jnp.sum(lax.fori_loop(0, R, body, x0).astype(
+                jnp.float32))
+
+        dt = timed(jax.jit(run), x, args.steps, R)
+        print(f"{name:10} {dt*1e3:8.3f} {flops/dt/1e12:7.1f}", flush=True)
+
+    for name, n, h, w, ci, co in PAIRS:
+        if only and not any(t in name for t in only):
+            continue
+        x = jnp.asarray(rng.randn(n, h, w, ci), jnp.bfloat16)
+        w1 = jnp.asarray(rng.randn(1, 1, ci, co) * 0.05, jnp.bfloat16)
+        w2 = jnp.asarray(rng.randn(1, 1, co, ci) * 0.05, jnp.bfloat16)
+        flops = 2 * n * h * w * ci * co  # per application (avg of pair)
+
+        def run(x0, w1=w1, w2=w2):
+            def body(_, y):
+                return conv(conv(y, w1), w2)
+
+            return jnp.sum(lax.fori_loop(0, R // 2, body, x0).astype(
+                jnp.float32))
+
+        dt = timed(jax.jit(run), x, args.steps, R)
+        print(f"{name:10} {dt*1e3:8.3f} {flops/dt/1e12:7.1f}", flush=True)
 
 
 if __name__ == "__main__":

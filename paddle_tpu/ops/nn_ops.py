@@ -42,6 +42,21 @@ def _conv2d(ctx):
     groups = ctx.attr("groups", 1)
     out_dt = amp.out_dtype(x)
     x, w = amp.cast_operands(x, w)
+    from paddle_tpu import pallas as pk
+
+    if (groups == 1 and dilations == (1, 1) and pads[0] == pads[1]
+            and strides[0] == strides[1] and pk.use_conv2d(
+                x.shape[0], x.shape[2], x.shape[3], x.shape[1], w.shape[0],
+                w.shape[2], w.shape[3], strides[0], pads[0])):
+        from paddle_tpu.pallas.conv import conv2d_nhwc
+
+        out = conv2d_nhwc(
+            jnp.transpose(x, (0, 2, 3, 1)),
+            jnp.transpose(w, (2, 3, 1, 0)).astype(x.dtype), pads[0],
+            pk.interpret_mode())
+        ctx.set_output("Output",
+                       jnp.transpose(out, (0, 3, 1, 2)).astype(out_dt))
+        return
     out = lax.conv_general_dilated(
         x,
         w,
@@ -114,14 +129,38 @@ def _pool2d(ctx):
     window = (1, 1) + ksize
     strides4 = (1, 1) + strides
     padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    # max/sum windows are separable: two 1-D passes do kh+kw work per
+    # output instead of kh*kw (a 32x32 stride-1 pool drops from 1024 to
+    # 64 ops/element — the XLA CPU backend at low opt levels does not
+    # perform this rewrite itself)
+    separable = ksize[0] > 1 and ksize[1] > 1
+
+    def _sep(v, init, op):
+        h = lax.reduce_window(v, init, op, (1, 1, ksize[0], 1),
+                              (1, 1, strides[0], 1),
+                              ((0, 0), (0, 0), (pads[0], pads[0]), (0, 0)))
+        return lax.reduce_window(h, init, op, (1, 1, 1, ksize[1]),
+                                 (1, 1, 1, strides[1]),
+                                 ((0, 0), (0, 0), (0, 0),
+                                  (pads[1], pads[1])))
+
     if ptype == "max":
         init = -jnp.inf
-        out = lax.reduce_window(x, init, lax.max, window, strides4, padding)
+        if separable:
+            out = _sep(x, init, lax.max)
+        else:
+            out = lax.reduce_window(x, init, lax.max, window, strides4,
+                                    padding)
     else:
-        summed = lax.reduce_window(x.astype(jnp.float32), 0.0, lax.add, window, strides4, padding)
+        xf = x.astype(jnp.float32)
+        summed = (_sep(xf, 0.0, lax.add) if separable else
+                  lax.reduce_window(xf, 0.0, lax.add, window, strides4,
+                                    padding))
         if ctx.attr("exclusive", False):
             ones = jnp.ones_like(x, dtype=jnp.float32)
-            counts = lax.reduce_window(ones, 0.0, lax.add, window, strides4, padding)
+            counts = (_sep(ones, 0.0, lax.add) if separable else
+                      lax.reduce_window(ones, 0.0, lax.add, window,
+                                        strides4, padding))
             out = (summed / counts).astype(x.dtype)
         else:
             out = (summed / (ksize[0] * ksize[1])).astype(x.dtype)

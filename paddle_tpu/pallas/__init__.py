@@ -113,6 +113,21 @@ def use_batch_norm(rows: int, cols: int) -> bool:
     return _STATE["mode"] == "on" and _b.fits(rows, cols)
 
 
+def use_conv2d(n: int, h: int, w: int, c: int, o: int, kh: int, kw: int,
+               stride: int, padding: int) -> bool:
+    """Implicit-GEMM conv kernels (pallas/conv.py).  Measured
+    (PALLAS_BENCH.md round 4, R=64 value-chains on the v5e): the XLA
+    conv emitter wins at every ResNet-50 hot shape — best kernel ratio
+    0.96x (c5 bwd-input), typical 0.83-0.90x, worst 0.37x (c2, where
+    C=64 wastes half the MXU lanes) — so the kernels are never
+    auto-dispatched; they remain as verified scaffolds for fused
+    custom-epilogue experiments."""
+    from paddle_tpu.pallas import conv as _c
+
+    return _STATE["mode"] == "on" and _c.fits(n, h, w, c, o, kh, kw,
+                                              stride, padding)
+
+
 def use_matmul() -> bool:
     return _STATE["mode"] == "on"  # measured 0.6-0.9x vs XLA: never auto
 
@@ -129,3 +144,4 @@ from paddle_tpu.pallas.flash_attention import (  # noqa: E402
     flash_attention as pallas_flash_attention)
 from paddle_tpu.pallas.batch_norm import (  # noqa: E402
     batch_norm_train as pallas_batch_norm_train)
+from paddle_tpu.pallas.conv import conv2d_nhwc as pallas_conv2d_nhwc  # noqa: E402

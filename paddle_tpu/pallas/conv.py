@@ -5,22 +5,24 @@ kernels instead of im2col-through-HBM).
 
 Design (stride-1 SAME convs, NHWC, the ResNet-50 3x3 family):
 
-- forward: grid ``(OH, KH)``, KH innermost.  Each step loads one padded
-  input row slab ``(B, 1, Wp, C)`` and accumulates the KW shifted
-  ``(B*OW, C) @ (C, O)`` products into an f32 VMEM accumulator; the
-  accumulator flushes to the output row when kh == KH-1.  M = B*OW
-  (14336 at c2, 1792 at c5) keeps the MXU pipelined even where W alone
+- forward: grid ``(NB, OH, KH)``, KH innermost.  Each step loads one
+  padded input row slab ``(bb, 1, Wp, C)`` for a batch block and
+  accumulates the KW shifted ``(bb*OW, C) @ (C, O)`` products into an
+  f32 VMEM accumulator; the accumulator flushes to the output row when
+  kh == KH-1.  M = bb*OW keeps the MXU pipelined even where W alone
   (7..56) could not.
 - backward-input: the same forward kernel applied to the padded
   cotangent with the spatially-flipped, channel-transposed filter
   (conv_transpose identity for stride 1).
-- backward-filter: grid ``(KH, OH)``, OH innermost.  Each step
-  contracts the x row slab against the cotangent row over M = B*OW
-  into a per-kh ``(KW*C, O)`` f32 accumulator (reset at oh == 0, flush
-  at oh == OH-1).
+- backward-filter: grid ``(KH, NB, OH)``, OH innermost.  Each step
+  contracts the x row slab against the cotangent row over M = bb*OW
+  into a per-kh ``(KW*C, O)`` f32 accumulator (reset at the first
+  (batch, row) step, flushed at the last).
 
 Whole-filter blocks use constant index maps so Pallas keeps them
-resident in VMEM across grid steps instead of re-copying.
+resident in VMEM across grid steps instead of re-copying.  Batch
+blocks are sized so the working set (with sub-128 channel dims padded
+to full lanes) stays under the ~16 MB scoped-vmem budget.
 """
 
 from __future__ import annotations
@@ -33,76 +35,137 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+_VMEM_BUDGET = 9 * 1024 * 1024
+
+
+def _lanes(c):
+    return max(c, 128)
+
+
+def _fwd_batch_block(n, w, wp, c, o, kh, kw, fold_kw=False):
+    """Largest divisor-of-n batch block whose fwd working set fits
+    (x slab and out row double-buffered, resident filter, f32 acc).
+    Returns None when even the smallest block exceeds VMEM — the
+    caller must fall back to the XLA emitter."""
+    for bb in sorted((d for d in range(8, n + 1) if n % d == 0),
+                     reverse=True):
+        vmem = (2 * bb * wp * _lanes(c) * 2      # double-buffered x slab
+                + bb * w * _lanes(o) * 4         # f32 accumulator
+                + 2 * bb * w * _lanes(o) * 2     # double-buffered out row
+                + kh * kw * c * _lanes(o) * 2)   # resident filter
+        if fold_kw:
+            vmem += bb * w * kw * c * 2          # staged K=KW*C patch
+        if vmem <= _VMEM_BUDGET:
+            return bb
+    return None
+
+
+def _dw_batch_block(n, ow, wp, c, o, kh, kw):
+    for bb in sorted((d for d in range(8, n + 1) if n % d == 0),
+                     reverse=True):
+        vmem = (2 * bb * wp * _lanes(c) * 2 + 2 * bb * ow * _lanes(o) * 2
+                + kw * c * _lanes(o) * 4 + kh * kw * c * _lanes(o) * 4)
+        if vmem <= _VMEM_BUDGET:
+            return bb
+    return None
+
 
 def fits(n, h, w, c, o, kh, kw, stride, padding) -> bool:
     """Kernel applicability: stride-1 SAME square convs with
-    MXU-friendly channel counts and a VMEM-sized row slab."""
+    MXU-friendly channel counts and a batch block that fits VMEM in
+    every direction (fwd, bwd-input, bwd-filter)."""
     if stride != 1 or kh != kw or kh % 2 == 0:
         return False
     if padding != kh // 2:
         return False
-    if c % 64 or o % 64 or (n * w) % 8:
+    if c % 64 or o % 64 or n % 8:
         return False
     wp = w + 2 * padding
-    vmem = (2 * n * wp * c * 2          # double-buffered x slab (bf16)
-            + kh * kw * c * o * 2       # resident filter
-            + n * w * o * 4             # f32 accumulator
-            + n * w * o * 2)            # output row
-    return vmem <= 13 * 1024 * 1024
+    return (_fwd_batch_block(n, w, wp, c, o, kh, kw) is not None
+            and _fwd_batch_block(n, w, wp, o, c, kh, kw) is not None
+            and _dw_batch_block(n, w, wp, c, o, kh, kw) is not None)
 
 
-def _fwd_kernel(x_ref, w_ref, o_ref, acc_ref, *, kh_steps, kw_steps, ow):
-    kh = pl.program_id(1)
+def _fwd_kernel(x_ref, w_ref, o_ref, acc_ref, *scratch, kh_steps,
+                kw_steps, ow, fold_kw):
+    kh = pl.program_id(2)
 
     @pl.when(kh == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    row = x_ref[:, 0]                       # (B, Wp, C)
+    row = x_ref[:, 0]                       # (bb, Wp, C)
     b = row.shape[0]
-    for kw in range(kw_steps):
-        patch = row[:, kw:kw + ow].reshape(b * ow, -1)
-        acc_ref[:] += jnp.dot(patch, w_ref[kh, kw],
+    c = row.shape[-1]
+    if fold_kw:
+        (patch_ref,) = scratch
+        # one MXU pass with K = KW*C: the kw shifts happen either way,
+        # folding them into the contraction amortizes MXU setup.
+        # Mosaic cannot concat sublane-shifted vectors, so the shifted
+        # slices are staged through a scratch buffer lane-block-wise.
+        for kw in range(kw_steps):
+            patch_ref[:, :, kw * c:(kw + 1) * c] = row[:, kw:kw + ow]
+        patch = patch_ref[:].reshape(b * ow, kw_steps * c)
+        wk = w_ref[kh].reshape(kw_steps * c, -1)
+        acc_ref[:] += jnp.dot(patch, wk,
                               preferred_element_type=jnp.float32)
+    else:
+        for kw in range(kw_steps):
+            patch = row[:, kw:kw + ow].reshape(b * ow, -1)
+            acc_ref[:] += jnp.dot(patch, w_ref[kh, kw],
+                                  preferred_element_type=jnp.float32)
 
     @pl.when(kh == kh_steps - 1)
     def _flush():
         o_ref[:, 0] = acc_ref[:].reshape(b, ow, -1).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("padding", "interpret"))
-def _conv_fwd_impl(x, w, padding: int, interpret: bool = False):
+@functools.partial(jax.jit, static_argnames=("padding", "interpret",
+                                             "fold_kw"))
+def _conv_fwd_impl(x, w, padding: int, interpret: bool = False,
+                   fold_kw: bool = False):
     n, h, wd, c = x.shape
     kh, kw, c2, o = w.shape
     assert c == c2, (x.shape, w.shape)
     p = padding
     xp = jnp.pad(x, [(0, 0), (p, p), (p, p), (0, 0)])
     wp = wd + 2 * p
+    bb = _fwd_batch_block(n, wd, wp, c, o, kh, kw, fold_kw=fold_kw)
+    assert bb is not None, (
+        f"conv working set exceeds VMEM at every batch block "
+        f"({x.shape} w={w.shape}); gate calls behind fits()")
+    scratch = [pltpu.VMEM((bb * wd, o), jnp.float32)]
+    if fold_kw:
+        scratch.append(pltpu.VMEM((bb, wd, kw * c), x.dtype))
     return pl.pallas_call(
-        functools.partial(_fwd_kernel, kh_steps=kh, kw_steps=kw, ow=wd),
-        grid=(h, kh),
+        functools.partial(_fwd_kernel, kh_steps=kh, kw_steps=kw, ow=wd,
+                          fold_kw=fold_kw),
+        grid=(n // bb, h, kh),
         in_specs=[
-            pl.BlockSpec((n, 1, wp, c), lambda oh, k: (0, oh + k, 0, 0)),
-            pl.BlockSpec((kh, kw, c, o), lambda oh, k: (0, 0, 0, 0)),
+            pl.BlockSpec((bb, 1, wp, c), lambda b, oh, k: (b, oh + k, 0, 0)),
+            pl.BlockSpec((kh, kw, c, o), lambda b, oh, k: (0, 0, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((n, 1, wd, o), lambda oh, k: (0, oh, 0, 0)),
+        out_specs=pl.BlockSpec((bb, 1, wd, o),
+                               lambda b, oh, k: (b, oh, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((n, h, wd, o), x.dtype),
-        scratch_shapes=[pltpu.VMEM((n * wd, o), jnp.float32)],
+        scratch_shapes=scratch,
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(xp, w)
 
 
-def _dw_kernel(x_ref, g_ref, dw_ref, acc_ref, *, oh_steps, kw_steps, ow):
-    oh = pl.program_id(1)
+def _dw_kernel(x_ref, g_ref, dw_ref, acc_ref, *, nb_steps, oh_steps,
+               kw_steps, ow):
+    b_i = pl.program_id(1)
+    oh = pl.program_id(2)
 
-    @pl.when(oh == 0)
+    @pl.when(jnp.logical_and(b_i == 0, oh == 0))
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    row = x_ref[:, 0]                       # (B, Wp, C)
-    gg = g_ref[:, 0]                        # (B, OW, O)
+    row = x_ref[:, 0]                       # (bb, Wp, C)
+    gg = g_ref[:, 0]                        # (bb, OW, O)
     b = row.shape[0]
     c = row.shape[-1]
     gflat = gg.reshape(b * ow, -1)
@@ -112,7 +175,7 @@ def _dw_kernel(x_ref, g_ref, dw_ref, acc_ref, *, oh_steps, kw_steps, ow):
             patch, gflat, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(oh == oh_steps - 1)
+    @pl.when(jnp.logical_and(b_i == nb_steps - 1, oh == oh_steps - 1))
     def _flush():
         dw_ref[0] = acc_ref[:].reshape(
             kw_steps, c, -1).astype(dw_ref.dtype)
@@ -127,18 +190,23 @@ def _conv_dw_impl(x, g, kernel: int, padding: int, interpret: bool = False):
     p = padding
     xp = jnp.pad(x, [(0, 0), (p, p), (p, p), (0, 0)])
     wp = wd + 2 * p
+    bb = _dw_batch_block(n, ow, wp, c, o, kh, kw)
+    assert bb is not None, (
+        f"conv-dw working set exceeds VMEM at every batch block "
+        f"({x.shape} g={g.shape}); gate calls behind fits()")
     return pl.pallas_call(
-        functools.partial(_dw_kernel, oh_steps=oh, kw_steps=kw, ow=ow),
-        grid=(kh, oh),
+        functools.partial(_dw_kernel, nb_steps=n // bb, oh_steps=oh,
+                          kw_steps=kw, ow=ow),
+        grid=(kh, n // bb, oh),
         in_specs=[
-            pl.BlockSpec((n, 1, wp, c), lambda k, r: (0, r + k, 0, 0)),
-            pl.BlockSpec((n, 1, ow, o), lambda k, r: (0, r, 0, 0)),
+            pl.BlockSpec((bb, 1, wp, c), lambda k, b, r: (b, r + k, 0, 0)),
+            pl.BlockSpec((bb, 1, ow, o), lambda k, b, r: (b, r, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, kw, c, o), lambda k, r: (k, 0, 0, 0)),
+        out_specs=pl.BlockSpec((1, kw, c, o), lambda k, b, r: (k, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((kh, kw, c, o), jnp.float32),
         scratch_shapes=[pltpu.VMEM((kw * c, o), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary", "arbitrary")),
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
         interpret=interpret,
     )(xp, g)
 
