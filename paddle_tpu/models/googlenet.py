@@ -11,9 +11,9 @@ from paddle_tpu import layers
 __all__ = ["googlenet"]
 
 
-def _conv(x, nf, k, pad=0, act="relu"):
+def _conv(x, nf, k, pad=0, stride=1, act="relu"):
     return layers.conv2d(x, num_filters=nf, filter_size=k, padding=pad,
-                         act=act)
+                         stride=stride, act=act)
 
 
 def inception(x, c1, c3r, c3, c5r, c5, proj):
@@ -30,7 +30,10 @@ def googlenet(input, class_dim: int = 1000, is_test: bool = False):
     """input: (B, 3, 224, 224) -> softmax over class_dim.  The two
     auxiliary heads of the paper are omitted as in the reference
     benchmark config (googlenet.py trains the main tower only)."""
-    x = _conv(input, 64, 7, pad=3)
+    # 7x7/s2 stem (reference benchmark/paddle/image/googlenet.py:169
+    # stride=2 — round 4 fixed a missing stride here that ran the whole
+    # stem at 224^2, 4x the canonical work)
+    x = _conv(input, 64, 7, pad=3, stride=2)
     x = layers.pool2d(x, pool_size=3, pool_stride=2, pool_padding=1,
                       pool_type="max")
     x = _conv(x, 64, 1)
@@ -50,6 +53,8 @@ def googlenet(input, class_dim: int = 1000, is_test: bool = False):
                       pool_type="max")
     x = inception(x, 256, 160, 320, 32, 128, 128)  # 5a
     x = inception(x, 384, 192, 384, 48, 128, 128)  # 5b
-    x = layers.pool2d(x, pool_size=7, pool_stride=7, pool_type="avg")
+    # global average pool (7x7 at the canonical 224 input; global so
+    # sub-224 inputs don't collapse to a zero-sized map)
+    x = layers.pool2d(x, pool_size=7, pool_type="avg", global_pooling=True)
     x = layers.dropout(x, dropout_prob=0.4, is_test=is_test)
     return layers.fc(input=x, size=class_dim, act="softmax")

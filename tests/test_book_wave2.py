@@ -266,8 +266,13 @@ def test_googlenet_forward_and_train_step(rng):
     exe.run(fluid.default_startup_program())
     xs = rng.randn(2, 3, 112, 112).astype("float32")
     ys = rng.randint(0, 10, (2, 1)).astype("int64")
-    (l,) = exe.run(feed={"img": xs, "label": ys}, fetch_list=[loss])
-    assert np.isfinite(float(l))
+    (l,), (p,) = [exe.run(feed={"img": xs, "label": ys},
+                          fetch_list=[f])
+                  for f in (loss, pred)]
+    assert np.isfinite(float(np.asarray(l)))
+    # the logits must depend on the image (guards against a degenerate
+    # head, e.g. a zero-sized feature map feeding a bias-only fc)
+    assert np.asarray(p).std(axis=0).mean() > 1e-7
 
 
 def test_wide_deep_sparse_ctr(rng):
