@@ -1,0 +1,64 @@
+/* Conv-model inference from pure C (reference:
+ * paddle/capi/examples/model_inference/ — the reference deploys conv
+ * and sequence models through the same C contract as dense ones):
+ * load a LeNet-class model saved by save_inference_model, feed one
+ * NCHW image, print the output row.
+ *
+ * Build (see tests/test_capi.py for the exact command):
+ *   g++ -o conv_infer conv_infer.c -L<repo>/capi \
+ *       -lpaddle_tpu_capi_native
+ * Run:  ./conv_infer <model_dir> <C> <H> <W>
+ */
+
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "../paddle_tpu_capi.h"
+
+int main(int argc, char** argv) {
+  if (argc < 5) {
+    fprintf(stderr, "usage: %s <model_dir> <C> <H> <W>\n", argv[0]);
+    return 2;
+  }
+  const char* model_dir = argv[1];
+  int c = atoi(argv[2]), h = atoi(argv[3]), w = atoi(argv[4]);
+  int n = c * h * w;
+
+  if (pd_init(getenv("PADDLE_TPU_ROOT")) != 0) {
+    fprintf(stderr, "init failed: %s\n", pd_last_error());
+    return 1;
+  }
+  pd_machine machine;
+  if (pd_machine_create_for_inference(&machine, model_dir) != 0) {
+    fprintf(stderr, "create failed: %s\n", pd_last_error());
+    return 1;
+  }
+
+  float* in = (float*)malloc(sizeof(float) * n);
+  for (int i = 0; i < n; ++i) in[i] = (float)(i % 37) / 37.0f - 0.5f;
+  int64_t dims[4] = {1, c, h, w};
+  if (pd_machine_feed_f32(machine, "img", in, dims, 4) != 0 ||
+      pd_machine_forward(machine) != 0) {
+    fprintf(stderr, "forward failed: %s\n", pd_last_error());
+    return 1;
+  }
+
+  int64_t odims[8];
+  int ondim = 8;
+  pd_machine_output_dims(machine, 0, odims, &ondim);
+  int64_t total = 1;
+  for (int i = 0; i < ondim; ++i) total *= odims[i];
+  float* out = (float*)malloc(sizeof(float) * total);
+  if (pd_machine_output_f32(machine, 0, out, (uint64_t)total) != 0) {
+    fprintf(stderr, "fetch failed: %s\n", pd_last_error());
+    return 1;
+  }
+  printf("output:");
+  for (int64_t i = 0; i < total; ++i) printf(" %.6f", out[i]);
+  printf("\n");
+  pd_machine_destroy(machine);
+  free(in);
+  free(out);
+  return 0;
+}

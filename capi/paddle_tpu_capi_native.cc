@@ -4,12 +4,16 @@
 // paddle_tpu.io.save_inference_model.
 //
 // Reference contract: paddle/capi/gradient_machine.h:36-73 — a C
-// library deployable with no interpreter on the box.  The embedded-
-// CPython implementation (paddle_tpu_capi.cc) remains the full-surface
-// fallback; this library covers the exported-MLP op set (mul,
-// elementwise add/mul/sub, relu/sigmoid/tanh/softmax/scale, reshape,
-// dropout/batch_norm in inference form) and fails with a clear error
-// naming any op outside it.
+// library deployable with no interpreter on the box (the reference's
+// capi examples deploy dense AND conv models:
+// capi/examples/model_inference/).  The embedded-CPython
+// implementation (paddle_tpu_capi.cc) remains the full-surface
+// fallback; this library covers the exported MLP + convnet op set
+// (mul, elementwise add/mul/sub with paddle axis broadcast, conv2d,
+// pool2d max/avg, relu/sigmoid/tanh/softmax/scale, reshape,
+// dropout/batch_norm in inference form) — enough for LeNet-class
+// image models — and fails with a clear error naming any op outside
+// it.
 //
 // Build:   g++ -O2 -shared -fPIC -o libpaddle_tpu_capi_native.so \
 //              paddle_tpu_capi_native.cc
@@ -295,6 +299,19 @@ double AttrNum(const Json& op, const char* key, double dflt) {
   return dflt;
 }
 
+// 2-element int array attr (strides/paddings/ksize...), scalar default
+std::vector<int64_t> AttrPair(const Json& op, const char* key,
+                              int64_t dflt) {
+  std::vector<int64_t> v{dflt, dflt};
+  const Json* attrs = op.Get("attrs");
+  const Json* a = attrs ? attrs->Get(key) : nullptr;
+  if (a && a->kind == Json::kArr && a->arr.size() == 2) {
+    v[0] = static_cast<int64_t>(a->arr[0].num);
+    v[1] = static_cast<int64_t>(a->arr[1].num);
+  }
+  return v;
+}
+
 int RunOp(Machine* m, const Json& op) {
   const std::string type = op.Get("type") ? op.Get("type")->str : "";
   auto val = [&](const char* slot) -> Tensor* {
@@ -335,25 +352,124 @@ int RunOp(Machine* m, const Json& op) {
     Tensor out = *x;
     int64_t n = x->numel();
     int64_t yn = y->numel();
-    // only exact-shape or trailing broadcast (Y = X's trailing dims,
-    // e.g. a bias over the last axis) is implemented; reject others
-    // loudly rather than cycling Y down the flattened X
-    int64_t trailing = 1;
-    for (size_t d = x->dims.size(); d-- > 0;) {
-      trailing *= x->dims[d];
-      if (trailing == yn) break;
-      if (trailing > yn) { trailing = -1; break; }
-    }
-    if (yn != n && trailing != yn)
-      return Fail(type + ": Y shape is neither X's shape nor X's "
-                  "trailing dims; use the embedded-Python capi");
+    // paddle broadcast: the default axis anchors Y's ORIGINAL rank to
+    // X's trailing dims, THEN Y's trailing 1s are trimmed (reference
+    // operators/elementwise_op.h; same rule as ops/common.py).  Covers
+    // exact shape, trailing bias, the conv channel bias (axis=1,
+    // NCHW), and (B,1)-against-(B,D) rows.
+    int axis = static_cast<int>(AttrNum(op, "axis", -1));
+    if (axis < 0) axis = static_cast<int>(x->dims.size() - y->dims.size());
+    std::vector<int64_t> ydims = y->dims;
+    while (ydims.size() > 1 && ydims.back() == 1) ydims.pop_back();
+    if (axis < 0 ||
+        axis + ydims.size() > x->dims.size())
+      return Fail(type + ": Y rank does not fit X at axis");
+    for (size_t d = 0; d < ydims.size(); ++d)
+      if (ydims[d] != x->dims[axis + d])
+        return Fail(type + ": Y dims mismatch X at axis " +
+                    std::to_string(axis));
+    // inner = product of X dims after the Y window; yn repeats per
+    // inner block, cycling every yn*inner elements
+    int64_t inner = 1;
+    for (size_t d = axis + ydims.size(); d < x->dims.size(); ++d)
+      inner *= x->dims[d];
     for (int64_t i = 0; i < n; ++i) {
-      float b = y->data[yn == n ? i : i % yn];  // trailing broadcast
+      float b = y->data[(i / inner) % yn];
       float a = x->data[i];
       out.data[i] = type == "elementwise_add"   ? a + b
                     : type == "elementwise_sub" ? a - b
                                                 : a * b;
     }
+    m->values[OutName(op, "Out")] = std::move(out);
+    return 0;
+  }
+  if (type == "conv2d") {
+    Tensor* x = val("Input");
+    Tensor* w = val("Filter");
+    if (!x || !w) return Fail("conv2d: missing input");
+    if (x->dims.size() != 4 || w->dims.size() != 4)
+      return Fail("conv2d: expects NCHW input and OIHW filter");
+    if (static_cast<int>(AttrNum(op, "groups", 1)) != 1)
+      return Fail("conv2d: groups > 1 not in the Python-free op set");
+    auto st = AttrPair(op, "strides", 1), pd = AttrPair(op, "paddings", 0);
+    auto dl = AttrPair(op, "dilations", 1);
+    int64_t N = x->dims[0], C = x->dims[1], H = x->dims[2], W = x->dims[3];
+    int64_t O = w->dims[0], KH = w->dims[2], KW = w->dims[3];
+    if (w->dims[1] != C) return Fail("conv2d: filter C mismatch");
+    int64_t OH = (H + 2 * pd[0] - dl[0] * (KH - 1) - 1) / st[0] + 1;
+    int64_t OW = (W + 2 * pd[1] - dl[1] * (KW - 1) - 1) / st[1] + 1;
+    Tensor out;
+    out.dims = {N, O, OH, OW};
+    out.data.assign(N * O * OH * OW, 0.f);
+    for (int64_t nn = 0; nn < N; ++nn)
+      for (int64_t o = 0; o < O; ++o)
+        for (int64_t oh = 0; oh < OH; ++oh)
+          for (int64_t ow = 0; ow < OW; ++ow) {
+            float acc = 0.f;
+            for (int64_t c = 0; c < C; ++c)
+              for (int64_t kh = 0; kh < KH; ++kh) {
+                int64_t ih = oh * st[0] + kh * dl[0] - pd[0];
+                if (ih < 0 || ih >= H) continue;
+                const float* xr = &x->data[((nn * C + c) * H + ih) * W];
+                const float* wr = &w->data[((o * C + c) * KH + kh) * KW];
+                for (int64_t kw = 0; kw < KW; ++kw) {
+                  int64_t iw = ow * st[1] + kw * dl[1] - pd[1];
+                  if (iw < 0 || iw >= W) continue;
+                  acc += xr[iw] * wr[kw];
+                }
+              }
+            out.data[((nn * O + o) * OH + oh) * OW + ow] = acc;
+          }
+    m->values[OutName(op, "Output")] = std::move(out);
+    return 0;
+  }
+  if (type == "pool2d") {
+    Tensor* x = val("X");
+    if (!x) return Fail("pool2d: missing input");
+    if (x->dims.size() != 4) return Fail("pool2d: expects NCHW");
+    const Json* attrs = op.Get("attrs");
+    std::string ptype = "max";
+    if (attrs && attrs->Get("pooling_type"))
+      ptype = attrs->Get("pooling_type")->str;
+    auto ks = AttrPair(op, "ksize", 2), st = AttrPair(op, "strides", 1),
+         pd = AttrPair(op, "paddings", 0);
+    bool global_pool = AttrNum(op, "global_pooling", 0) != 0;
+    bool exclusive = AttrNum(op, "exclusive", 0) != 0;
+    int64_t N = x->dims[0], C = x->dims[1], H = x->dims[2], W = x->dims[3];
+    if (global_pool) {
+      ks = {H, W};
+      st = {1, 1};
+      pd = {0, 0};
+    }
+    int64_t OH = (H + 2 * pd[0] - ks[0]) / st[0] + 1;
+    int64_t OW = (W + 2 * pd[1] - ks[1]) / st[1] + 1;
+    Tensor out;
+    out.dims = {N, C, OH, OW};
+    out.data.assign(N * C * OH * OW, 0.f);
+    for (int64_t nc = 0; nc < N * C; ++nc)
+      for (int64_t oh = 0; oh < OH; ++oh)
+        for (int64_t ow = 0; ow < OW; ++ow) {
+          float acc = ptype == "max" ? -3.4e38f : 0.f;
+          int64_t cnt = 0;
+          for (int64_t kh = 0; kh < ks[0]; ++kh) {
+            int64_t ih = oh * st[0] + kh - pd[0];
+            if (ih < 0 || ih >= H) continue;
+            for (int64_t kw = 0; kw < ks[1]; ++kw) {
+              int64_t iw = ow * st[1] + kw - pd[1];
+              if (iw < 0 || iw >= W) continue;
+              float v = x->data[(nc * H + ih) * W + iw];
+              if (ptype == "max")
+                acc = std::max(acc, v);
+              else
+                acc += v;
+              ++cnt;
+            }
+          }
+          if (ptype != "max")
+            acc /= static_cast<float>(exclusive ? std::max<int64_t>(cnt, 1)
+                                                : ks[0] * ks[1]);
+          out.data[(nc * OH + oh) * OW + ow] = acc;
+        }
     m->values[OutName(op, "Out")] = std::move(out);
     return 0;
   }
