@@ -266,9 +266,8 @@ def test_googlenet_forward_and_train_step(rng):
     exe.run(fluid.default_startup_program())
     xs = rng.randn(2, 3, 112, 112).astype("float32")
     ys = rng.randint(0, 10, (2, 1)).astype("int64")
-    (l,), (p,) = [exe.run(feed={"img": xs, "label": ys},
-                          fetch_list=[f])
-                  for f in (loss, pred)]
+    l, p = exe.run(feed={"img": xs, "label": ys},
+                   fetch_list=[loss, pred])
     assert np.isfinite(float(np.asarray(l)))
     # the logits must depend on the image (guards against a degenerate
     # head, e.g. a zero-sized feature map feeding a bias-only fc)

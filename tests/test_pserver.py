@@ -258,8 +258,16 @@ def test_v2_remote_training_end_to_end():
     the loss drops — the NewRemoteParameterUpdater workflow
     (trainer/NewRemoteParameterUpdater.cpp:48; v2/trainer.py remote mode)
     with local fwd/bwd on TPU and the optimizer server-side."""
+    import random
+
     import paddle_tpu.v2 as paddle
 
+    # reader.shuffle draws from the global `random` module: pin it so
+    # the training trajectory is identical standalone and mid-suite
+    # (the convergence assertion was flaky after ~500 other tests had
+    # advanced the global state)
+    random.seed(7)
+    np.random.seed(7)
     paddle.init(use_gpu=False, trainer_count=1)
     x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(13))
     y = paddle.layer.data(name="y", type=paddle.data_type.dense_vector(1))
