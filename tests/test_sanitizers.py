@@ -18,11 +18,24 @@ _DRIVER = os.path.join(_HERE, "native_sanitize.cc")
 
 
 def _build_and_run(tmp_path, san_flag, env_extra):
-    exe = str(tmp_path / f"native_san_{san_flag.split('=')[1].split(',')[0]}")
-    cmd = ["g++", "-std=c++17", "-g", "-O0", "-pthread", san_flag,
-           "-fno-omit-frame-pointer", "-o", exe] + _SOURCES + [_DRIVER]
-    build = subprocess.run(cmd, capture_output=True, text=True)
-    assert build.returncode == 0, build.stderr[-3000:]
+    # cache the sanitizer binary on (sources, flags) hash — the g++
+    # builds were ~20 s of every suite run; the sanitized RUN is the
+    # test, so it always executes
+    import hashlib
+
+    h = hashlib.sha256(san_flag.encode())
+    for s in _SOURCES + [_DRIVER]:
+        h.update(open(s, "rb").read())
+    cache_dir = os.path.join(os.path.expanduser("~"), ".cache",
+                             "paddle_tpu_test_native")
+    os.makedirs(cache_dir, exist_ok=True)
+    tag = san_flag.split("=")[1].split(",")[0]
+    exe = os.path.join(cache_dir, f"native_san_{tag}_{h.hexdigest()[:16]}")
+    if not os.path.exists(exe):
+        cmd = ["g++", "-std=c++17", "-g", "-O0", "-pthread", san_flag,
+               "-fno-omit-frame-pointer", "-o", exe] + _SOURCES + [_DRIVER]
+        build = subprocess.run(cmd, capture_output=True, text=True)
+        assert build.returncode == 0, build.stderr[-3000:]
     env = dict(os.environ, **env_extra)
     run = subprocess.run([exe, str(tmp_path)], capture_output=True,
                          text=True, env=env, timeout=300)
