@@ -239,7 +239,10 @@ def _ssd_loss(ctx):
         prior = prior.reshape(-1, M_, 4)[0]
         pvar = pvar.reshape(-1, M_, 4)[0]
     gt = unwrap(ctx.input("GtBox")).astype(jnp.float32)      # (B, G, 4)
-    gtl = unwrap(ctx.input("GtLabel")).reshape(gt.shape[0], -1)  # (B, G)
+    # labels may arrive as the real-valued column of a packed gt record
+    # (the v1 flat label layout); they index class rows, so integerize
+    gtl = unwrap(ctx.input("GtLabel")).reshape(
+        gt.shape[0], -1).astype(jnp.int32)  # (B, G)
     overlap_t = float(ctx.attr("overlap_threshold", 0.5))
     neg_ratio = float(ctx.attr("neg_pos_ratio", 3.0))
     background = int(ctx.attr("background_label", 0))

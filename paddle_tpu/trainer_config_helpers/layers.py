@@ -537,8 +537,10 @@ def table_projection(input, size: int = 0, param_attr=None, **kwargs):
     def build(ctx, ids, mixed_size):
         from paddle_tpu import layers as L
 
-        return L.embedding(input=ids, size=[input.size, mixed_size],
-                           param_attr=param_attr)
+        idv = ids.var if isinstance(ids, SeqVal) else ids
+        out = L.embedding(input=idv, size=[input.size, mixed_size],
+                          param_attr=param_attr)
+        return SeqVal(out, ids.lengths) if isinstance(ids, SeqVal) else out
 
     return _Projection(input, build, out_size=size or None)
 

@@ -536,6 +536,10 @@ def bilinear_interp_layer(input, out_size_x, out_size_y, num_channels=None,
 
 def priorbox_layer(input, image, min_size, max_size=None, aspect_ratio=None,
                    variance=(0.1, 0.1, 0.2, 0.2), name=None, **kw):
+    """SSD anchors (reference: gserver/layers/PriorBox.cpp, whose
+    output row IS the flat [M*4 boxes | M*4 variances] layout the SSD
+    loss/output layers consume — the same contract _prior_slices
+    unpacks)."""
     def build(ctx, x, img):
         from paddle_tpu import layers as L
 
@@ -544,20 +548,79 @@ def priorbox_layer(input, image, min_size, max_size=None, aspect_ratio=None,
                                  max_sizes=list(max_size or []),
                                  aspect_ratios=list(aspect_ratio or []),
                                  variances=list(variance))
-        return boxes
+        return L.concat([L.reshape(boxes, [1, -1]),
+                         L.reshape(var, [1, -1])], axis=1)
 
     return _simple("priorbox", [input, image], build, name=name)
+
+
+def _ssd_geometry(input_loc, input_conf, priorbox):
+    """Shared SSD feed geometry (reference: MultiBoxLossLayer.cpp /
+    DetectionOutputLayer.cpp input contract): priorbox rows carry M
+    priors as [M*4 boxes | M*4 variances]; loc is (B, M*4); conf is
+    (B, M*C).  M derives from whichever of priorbox/input_loc has a
+    static size (priorbox_layer's is runtime-shaped), and the two are
+    cross-checked when both are known."""
+    m = (priorbox.size or 0) // 8 or None
+    if input_loc.size:
+        m_loc = input_loc.size // 4
+        if m is not None and m != m_loc:
+            raise ValueError(
+                f"SSD geometry mismatch: priorbox size {priorbox.size} "
+                f"implies {m} priors but input_loc size {input_loc.size} "
+                f"implies {m_loc}")
+        m = m if m is not None else m_loc
+    if not m:
+        raise ValueError(
+            "SSD layers need a statically sized priorbox or input_loc "
+            "to derive the prior count")
+    c = max((input_conf.size or m) // m, 1)
+    return m, c
+
+
+def _prior_slices(pb_flat, m):
+    """Flat per-sample priorbox (B, 2*M*4) -> shared (M, 4) boxes and
+    (M, 4) variances (priors are identical across the batch; take the
+    first row, as the reference's PriorBoxLayer emits batch-1)."""
+    from paddle_tpu import layers as L
+
+    row0 = _op("slice_tensor", {"X": [pb_flat]},
+               {"axes": [0], "starts": [0], "ends": [1]})
+    pbr = L.reshape(row0, [2, m, 4])
+    boxes = L.reshape(_op("slice_tensor", {"X": [pbr]},
+                          {"axes": [0], "starts": [0], "ends": [1]}),
+                      [m, 4])
+    pvar = L.reshape(_op("slice_tensor", {"X": [pbr]},
+                         {"axes": [0], "starts": [1], "ends": [2]}),
+                     [m, 4])
+    return boxes, pvar
 
 
 def multibox_loss_layer(input_loc, input_conf, priorbox, label, gt_box=None,
                         num_classes=2, overlap_threshold=0.5,
                         neg_pos_ratio=3.0, background_id=0, name=None, **kw):
+    """MultiBox/SSD loss over the v1 flat feed layout (reference:
+    gserver/layers/MultiBoxLossLayer.cpp; label rows are G ground-truth
+    records of 6 values [class, x1, y1, x2, y2, difficult])."""
     def build(ctx, loc, conf, pb, lab, *rest):
         from paddle_tpu import layers as L
 
-        gt = rest[0] if rest else lab
-        return L.mean(L.ssd_loss(_unwrap(loc), _unwrap(conf), _unwrap(pb),
-                                 _unwrap(pb), _unwrap(gt), _unwrap(lab),
+        m, c = _ssd_geometry(input_loc, input_conf, priorbox)
+        loc3 = L.reshape(_unwrap(loc), [0, m, 4])
+        conf3 = L.reshape(_unwrap(conf), [0, m, c])
+        boxes, pvar = _prior_slices(_unwrap(pb), m)
+        if rest:
+            gt = _unwrap(rest[0])
+            gtl = _unwrap(lab)
+        else:
+            g = max((label.size or 6) // 6, 1)
+            lab3 = L.reshape(_unwrap(lab), [0, g, 6])
+            gt = _op("slice_tensor", {"X": [lab3]},
+                     {"axes": [2], "starts": [1], "ends": [5]})
+            gtl = L.reshape(_op("slice_tensor", {"X": [lab3]},
+                                {"axes": [2], "starts": [0], "ends": [1]}),
+                            [0, -1])
+        return L.mean(L.ssd_loss(loc3, conf3, boxes, pvar, gt, gtl,
                                  overlap_threshold=overlap_threshold,
                                  neg_pos_ratio=neg_pos_ratio,
                                  background_label=background_id))
@@ -571,12 +634,21 @@ def detection_output_layer(input_loc, input_conf, priorbox, num_classes,
                            nms_threshold=0.45, nms_top_k=400,
                            keep_top_k=200, confidence_threshold=0.01,
                            background_id=0, name=None, **kw):
+    """SSD detection head over the v1 flat feed layout (reference:
+    gserver/layers/DetectionOutputLayer.cpp): decode loc offsets
+    against the shared priors, per-class NMS, cross-class top-k."""
     def build(ctx, loc, conf, pb):
         from paddle_tpu import layers as L
 
-        decoded = L.box_coder(_unwrap(pb), _unwrap(pb), _unwrap(loc),
+        m, c = _ssd_geometry(input_loc, input_conf, priorbox)
+        loc3 = L.reshape(_unwrap(loc), [0, m, 4])
+        # multiclass_nms scores are (B, C, M): per-prior class rows
+        conf3 = L.transpose(L.reshape(_unwrap(conf), [0, m, c]),
+                            perm=[0, 2, 1])
+        boxes, pvar = _prior_slices(_unwrap(pb), m)
+        decoded = L.box_coder(boxes, pvar, loc3,
                               code_type="decode_center_size")
-        return L.multiclass_nms(decoded, _unwrap(conf),
+        return L.multiclass_nms(decoded, conf3,
                                 score_threshold=confidence_threshold,
                                 nms_threshold=nms_threshold,
                                 nms_top_k=nms_top_k, keep_top_k=keep_top_k,
