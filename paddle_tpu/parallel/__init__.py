@@ -20,3 +20,4 @@ from paddle_tpu.parallel.ring_attention import (
     ring_attention,
     ring_attention_sharded,
 )
+from paddle_tpu.parallel.multihost import initialize, make_hybrid_mesh

@@ -1,0 +1,96 @@
+"""Multi-host SPMD: process initialization and ICI×DCN mesh layout.
+
+The reference scales across hosts with MPI/NCCL process groups plus its
+parameter-server RPC fabric (SURVEY §2.5: MultiGradientMachine +
+pserver/LightNetwork, gRPC send/recv).  The TPU-native replacement is
+jax.distributed: every host runs the SAME program, `initialize()`
+enrolls it in the cluster, and `jax.devices()` then spans every chip in
+the pod — after which the existing strategies (`DataParallelStrategy`,
+`HybridParallelStrategy`) and the executor's jit-with-shardings path
+work unchanged, with XLA routing collectives over ICI within a slice
+and DCN across slices.
+
+Mesh layout rule (the scaling-book recipe): DCN-spanning axes must be
+OUTERMOST and carry only bandwidth-light collectives (data-parallel
+gradient psum), while model axes (tp/sp/pp) stay inside a slice on
+ICI.  `make_hybrid_mesh` encodes exactly that split.
+
+The pserver/master/coord C++ services (paddle_tpu/native) remain the
+DCN control plane — dataset sharding, failure detection, checkpoints,
+async/sparse parameter service — matching SURVEY §7's division of
+labor: gradients ride ICI collectives, bookkeeping rides RPC.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+_initialized = [False]
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> int:
+    """Enroll this host in the cluster (idempotent).
+
+    On Cloud TPU pods every argument auto-detects from the metadata
+    server; elsewhere pass them explicitly or via PADDLE_TPU_COORD /
+    PADDLE_TPU_NPROC / PADDLE_TPU_PROC_ID (the same rendezvous triplet
+    the reference passes to mpirun/paddle pserver --port,--num_hosts).
+    Single-process runs (num_processes in (None, 1) with no
+    coordinator) skip initialization entirely.  Returns the process
+    index."""
+    coordinator_address = coordinator_address or os.environ.get(
+        "PADDLE_TPU_COORD")
+    if num_processes is None and os.environ.get("PADDLE_TPU_NPROC"):
+        num_processes = int(os.environ["PADDLE_TPU_NPROC"])
+    if process_id is None and os.environ.get("PADDLE_TPU_PROC_ID"):
+        process_id = int(os.environ["PADDLE_TPU_PROC_ID"])
+    if not _initialized[0]:
+        if coordinator_address is None and (num_processes or 1) == 1:
+            # single host: nothing to rendezvous
+            _initialized[0] = True
+            return 0
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+        _initialized[0] = True
+    return jax.process_index()
+
+
+def make_hybrid_mesh(ici_axes: Dict[str, int],
+                     dcn_axes: Optional[Dict[str, int]] = None) -> Mesh:
+    """Mesh whose ``dcn_axes`` (outermost) span slices over DCN and
+    whose ``ici_axes`` stay within a slice on ICI.
+
+    make_hybrid_mesh({"tp": 4, "sp": 2}, {"dp": 4}) on a 4-slice pod
+    of 8-chip slices yields a ("dp", "tp", "sp") mesh where only the
+    dp gradient psum crosses DCN.  On a single slice (or the virtual
+    CPU mesh) the DCN axes simply become leading axes of the local
+    device grid, so the same model code runs everywhere."""
+    dcn_axes = dcn_axes or {}
+    names = tuple(dcn_axes) + tuple(ici_axes)
+    sizes = tuple(dcn_axes.values()) + tuple(ici_axes.values())
+    n = int(np.prod(sizes))
+    if jax.process_count() > 1 and dcn_axes:
+        from jax.experimental import mesh_utils
+
+        # per-axis factorization: each mesh axis is (dcn part) x (ici
+        # part); dcn axes are ici-size 1 and vice versa
+        ici_shape = (1,) * len(dcn_axes) + tuple(ici_axes.values())
+        dcn_shape = tuple(dcn_axes.values()) + (1,) * len(ici_axes)
+        devs = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=ici_shape, dcn_mesh_shape=dcn_shape)
+        return Mesh(devs, names)
+    from paddle_tpu.parallel.strategy import make_mesh
+
+    devices = jax.devices()
+    assert len(devices) >= n, (
+        f"mesh needs {n} devices, have {len(devices)}")
+    return make_mesh({**dcn_axes, **ici_axes})

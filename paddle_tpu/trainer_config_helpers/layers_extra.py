@@ -548,19 +548,26 @@ def priorbox_layer(input, image, min_size, max_size=None, aspect_ratio=None,
                                  max_sizes=list(max_size or []),
                                  aspect_ratios=list(aspect_ratio or []),
                                  variances=list(variance))
-        return L.concat([L.reshape(boxes, [1, -1]),
-                         L.reshape(var, [1, -1])], axis=1)
+        # interleaved per-prior records, as the reference stores them
+        # (PriorBox.cpp clip loop '(d % 8) < 4'; DetectionUtil.cpp
+        # reads box at i*8, var at i*8+4)
+        rec = L.concat([L.reshape(boxes, [-1, 4]),
+                        L.reshape(var, [-1, 4])], axis=1)
+        return L.reshape(rec, [1, -1])
 
     return _simple("priorbox", [input, image], build, name=name)
 
 
-def _ssd_geometry(input_loc, input_conf, priorbox):
+def _ssd_geometry(input_loc, input_conf, priorbox, num_classes=None):
     """Shared SSD feed geometry (reference: MultiBoxLossLayer.cpp /
     DetectionOutputLayer.cpp input contract): priorbox rows carry M
-    priors as [M*4 boxes | M*4 variances]; loc is (B, M*4); conf is
-    (B, M*C).  M derives from whichever of priorbox/input_loc has a
-    static size (priorbox_layer's is runtime-shaped), and the two are
-    cross-checked when both are known."""
+    interleaved 8-value prior records [box(4) | var(4)]; loc is
+    (B, M*4); conf is (B, M*C).  M derives from whichever of
+    priorbox/input_loc has a static size (priorbox_layer's is
+    runtime-shaped), cross-checked when both are known; C comes from
+    the conf width, falling back to the declared num_classes (the
+    proto-test corpus declares sizes inconsistent with num_classes, so
+    the widths win when present)."""
     m = (priorbox.size or 0) // 8 or None
     if input_loc.size:
         m_loc = input_loc.size // 4
@@ -574,25 +581,28 @@ def _ssd_geometry(input_loc, input_conf, priorbox):
         raise ValueError(
             "SSD layers need a statically sized priorbox or input_loc "
             "to derive the prior count")
-    c = max((input_conf.size or m) // m, 1)
+    c = (input_conf.size or 0) // m or num_classes
+    if not c:
+        raise ValueError(
+            "SSD layers need a statically sized conf input or "
+            "num_classes to derive the class count")
     return m, c
 
 
 def _prior_slices(pb_flat, m):
-    """Flat per-sample priorbox (B, 2*M*4) -> shared (M, 4) boxes and
-    (M, 4) variances (priors are identical across the batch; take the
-    first row, as the reference's PriorBoxLayer emits batch-1)."""
+    """Flat per-sample priorbox (B, M*8 interleaved [box|var] records)
+    -> shared (M, 4) boxes and (M, 4) variances (priors are identical
+    across the batch; take the first row, as the reference's
+    PriorBoxLayer emits batch-1)."""
     from paddle_tpu import layers as L
 
     row0 = _op("slice_tensor", {"X": [pb_flat]},
                {"axes": [0], "starts": [0], "ends": [1]})
-    pbr = L.reshape(row0, [2, m, 4])
-    boxes = L.reshape(_op("slice_tensor", {"X": [pbr]},
-                          {"axes": [0], "starts": [0], "ends": [1]}),
-                      [m, 4])
-    pvar = L.reshape(_op("slice_tensor", {"X": [pbr]},
-                         {"axes": [0], "starts": [1], "ends": [2]}),
-                     [m, 4])
+    pbr = L.reshape(row0, [m, 8])
+    boxes = _op("slice_tensor", {"X": [pbr]},
+                {"axes": [1], "starts": [0], "ends": [4]})
+    pvar = _op("slice_tensor", {"X": [pbr]},
+               {"axes": [1], "starts": [4], "ends": [8]})
     return boxes, pvar
 
 
@@ -605,12 +615,12 @@ def multibox_loss_layer(input_loc, input_conf, priorbox, label, gt_box=None,
     def build(ctx, loc, conf, pb, lab, *rest):
         from paddle_tpu import layers as L
 
-        m, c = _ssd_geometry(input_loc, input_conf, priorbox)
+        m, c = _ssd_geometry(input_loc, input_conf, priorbox, num_classes)
         loc3 = L.reshape(_unwrap(loc), [0, m, 4])
         conf3 = L.reshape(_unwrap(conf), [0, m, c])
         boxes, pvar = _prior_slices(_unwrap(pb), m)
         if rest:
-            gt = _unwrap(rest[0])
+            gt = L.reshape(_unwrap(rest[0]), [0, -1, 4])
             gtl = _unwrap(lab)
         else:
             g = max((label.size or 6) // 6, 1)
@@ -640,11 +650,15 @@ def detection_output_layer(input_loc, input_conf, priorbox, num_classes,
     def build(ctx, loc, conf, pb):
         from paddle_tpu import layers as L
 
-        m, c = _ssd_geometry(input_loc, input_conf, priorbox)
+        m, c = _ssd_geometry(input_loc, input_conf, priorbox, num_classes)
         loc3 = L.reshape(_unwrap(loc), [0, m, 4])
-        # multiclass_nms scores are (B, C, M): per-prior class rows
-        conf3 = L.transpose(L.reshape(_unwrap(conf), [0, m, c]),
-                            perm=[0, 2, 1])
+        # per-prior softmax over classes first (the reference applies
+        # it before thresholding/NMS: DetectionOutputLayer.cpp:104),
+        # then to multiclass_nms's (B, C, M) score layout
+        probs = _op("softmax", {"X": [L.reshape(_unwrap(conf),
+                                                [0, m, c])]},
+                    shape=(-1, m, c))
+        conf3 = L.transpose(probs, perm=[0, 2, 1])
         boxes, pvar = _prior_slices(_unwrap(pb), m)
         decoded = L.box_coder(boxes, pvar, loc3,
                               code_type="decode_center_size")

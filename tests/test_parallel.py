@@ -347,3 +347,23 @@ def test_v2_trainer_count_data_parallel():
     assert dp.shape == single.shape
     np.testing.assert_allclose(dp, single, rtol=1e-4, atol=1e-5)
     assert dp[-1] < dp[0]
+
+
+def test_multihost_initialize_and_hybrid_mesh():
+    """Multi-host entry points (parallel/multihost.py): single-process
+    initialize() is a no-op returning index 0; make_hybrid_mesh lays
+    DCN axes outermost and the same strategies train over it (the
+    reference analog: MPI/NCCL process groups + pserver RPC fabric,
+    SURVEY §2.5)."""
+    from paddle_tpu.parallel import (DataParallelStrategy, initialize,
+                                     make_hybrid_mesh)
+
+    assert initialize() == 0
+    _mesh((8,), ("dp",))  # skip when <8 cpu devices
+    mesh = make_hybrid_mesh({"tp": 2, "sp": 2}, {"dp": 2})
+    assert mesh.axis_names == ("dp", "tp", "sp")
+    assert mesh.shape == {"dp": 2, "tp": 2, "sp": 2}
+    # a dp-outermost mesh trains through the normal strategy path
+    dp_mesh = make_hybrid_mesh({}, {"dp": 8})
+    losses = _train_smallnet_conv(DataParallelStrategy(dp_mesh, axis="dp"))
+    assert np.all(np.isfinite(losses)), losses
