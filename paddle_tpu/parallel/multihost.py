@@ -52,15 +52,22 @@ def initialize(coordinator_address: Optional[str] = None,
         num_processes = int(os.environ["PADDLE_TPU_NPROC"])
     if process_id is None and os.environ.get("PADDLE_TPU_PROC_ID"):
         process_id = int(os.environ["PADDLE_TPU_PROC_ID"])
-    if not _initialized[0]:
-        if coordinator_address is None and (num_processes or 1) == 1:
-            # single host: nothing to rendezvous
-            _initialized[0] = True
-            return 0
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes, process_id=process_id)
-        _initialized[0] = True
+    if _initialized[0]:
+        if coordinator_address is not None and _initialized[0] == "local":
+            raise RuntimeError(
+                "initialize() was already called without a coordinator "
+                "(single-host no-op); a later multi-host initialize("
+                f"{coordinator_address!r}) cannot take effect — call the "
+                "coordinated initialize() first in this process")
+        return jax.process_index()
+    if coordinator_address is None and (num_processes or 1) == 1:
+        # single host: nothing to rendezvous
+        _initialized[0] = "local"
+        return 0
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id)
+    _initialized[0] = "distributed"
     return jax.process_index()
 
 

@@ -537,9 +537,8 @@ def bilinear_interp_layer(input, out_size_x, out_size_y, num_channels=None,
 def priorbox_layer(input, image, min_size, max_size=None, aspect_ratio=None,
                    variance=(0.1, 0.1, 0.2, 0.2), name=None, **kw):
     """SSD anchors (reference: gserver/layers/PriorBox.cpp, whose
-    output row IS the flat [M*4 boxes | M*4 variances] layout the SSD
-    loss/output layers consume — the same contract _prior_slices
-    unpacks)."""
+    output row is M interleaved 8-value records [box(4) | var(4)] —
+    the same contract _prior_slices unpacks)."""
     def build(ctx, x, img):
         from paddle_tpu import layers as L
 
