@@ -307,6 +307,37 @@ def _padded_sequence_multi_slice(ctx):
     ctx.set_output("OutSubLength", sub_len)
 
 
+@register_op("padded_subseq_slice",
+             inputs=("X", "SubLength", "Starts", "Ends"),
+             outputs=("Out", "OutSubLength"), diff_inputs=("X",))
+def _padded_subseq_slice(ctx):
+    """Per-subsequence window slice of a padded nested sequence
+    (reference: SeqSliceLayer over a nested input — each subsequence s
+    of sample b yields its [starts[b,s], ends[b,s]) window, re-packed
+    to the front).  X (B, S, T, D), SubLength (B, S)."""
+    x = unwrap(ctx.input("X"))
+    sub = unwrap(ctx.input("SubLength")).astype(jnp.int32)   # (B, S)
+    B, S, T = x.shape[0], x.shape[1], x.shape[2]
+    starts = (unwrap(ctx.input("Starts")).astype(jnp.int32)
+              if ctx.has_input("Starts") else jnp.zeros_like(sub))
+    ends = (unwrap(ctx.input("Ends")).astype(jnp.int32)
+            if ctx.has_input("Ends") else sub)
+    # feeders may bucket-pad the starts/ends step dim past S
+    starts = starts.reshape(B, -1)[:, :S]
+    ends = ends.reshape(B, -1)[:, :S]
+    starts = jnp.clip(starts, 0, sub)
+    ends = jnp.clip(ends, starts, sub)
+    t = jnp.arange(T)[None, None, :]
+    idx = jnp.clip(starts[:, :, None] + t, 0, T - 1)          # (B, S, T)
+    gathered = jnp.take_along_axis(
+        x, idx.reshape((B, S, T) + (1,) * (x.ndim - 3)), axis=2)
+    new_len = ends - starts
+    mask = (t < new_len[:, :, None]).reshape(
+        (B, S, T) + (1,) * (x.ndim - 3))
+    ctx.set_output("Out", jnp.where(mask, gathered, 0))
+    ctx.set_output("OutSubLength", new_len)
+
+
 @register_op("padded_sequence_stride_pool", inputs=("X", "Length"),
              outputs=("Out", "OutLength"), diff_inputs=("X",))
 def _padded_sequence_stride_pool(ctx):

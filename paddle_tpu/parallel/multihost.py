@@ -53,12 +53,15 @@ def initialize(coordinator_address: Optional[str] = None,
     if process_id is None and os.environ.get("PADDLE_TPU_PROC_ID"):
         process_id = int(os.environ["PADDLE_TPU_PROC_ID"])
     if _initialized[0]:
-        if coordinator_address is not None and _initialized[0] == "local":
+        wants_cluster = (coordinator_address is not None
+                         or (num_processes or 1) > 1)
+        if wants_cluster and _initialized[0] == "local":
             raise RuntimeError(
                 "initialize() was already called without a coordinator "
-                "(single-host no-op); a later multi-host initialize("
-                f"{coordinator_address!r}) cannot take effect — call the "
-                "coordinated initialize() first in this process")
+                "(single-host no-op); a later multi-host initialize "
+                f"(coordinator={coordinator_address!r}, "
+                f"num_processes={num_processes}) cannot take effect — "
+                "call the coordinated initialize() first in this process")
         return jax.process_index()
     if coordinator_address is None and (num_processes or 1) == 1:
         # single host: nothing to rendezvous

@@ -348,16 +348,9 @@ def pooling_layer(input, pooling_type: Optional[BasePoolingType] = None,
                 return SeqVal(out, v.lengths) if to_seq else out
             # stride / max-index pooling act on the outer sequence view:
             # flatten the nested value to a packed plain sequence first
-            helper = LayerHelper("v1_subseq_flatten")
-            fv = helper.create_tmp_variable(
-                "float32", (-1, -1, input.size or 0))
-            fl = helper.create_tmp_variable("int32", (-1,))
-            helper.append_op(
-                type="subseq_flatten",
-                inputs={"X": [v.var], "Length": [v.lengths],
-                        "SubLength": [v.sub_lengths]},
-                outputs={"Out": [fv], "OutLength": [fl]})
-            v = SeqVal(fv, fl)
+            v = _v2._flatten_subseq(v)
+            if v.var.shape is None:
+                v.var.shape = (-1, -1, input.size or 0)
         assert isinstance(v, SeqVal), "pooling expects a sequence input"
         if max_index:
             return _op("padded_sequence_max_index",

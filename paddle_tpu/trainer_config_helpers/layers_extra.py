@@ -429,8 +429,19 @@ def kmax_seq_score_layer(input, beam_size=1, name=None, **kw):
     (reference KmaxSeqScoreLayer): returns the k step indices."""
     def build(ctx, x):
         from paddle_tpu import layers as L
+        from paddle_tpu.v2.layer import SubSeqVal
 
-        if isinstance(x, SeqVal):
+        if isinstance(x, SubSeqVal):
+            # nested scores (B, S, T, 1): rank candidates across every
+            # inner step of the sample's beam (reference
+            # KmaxSeqScoreLayer over a nested input scores each
+            # subsequence's steps; the flat top-k view is the padded
+            # equivalent), padding masked via the flattened lengths
+            flat = _v2._flatten_subseq(x)
+            scores = _op("mask_padded_scores",
+                         {"X": [L.reshape(flat.var, [0, -1])],
+                          "Length": [flat.lengths]})
+        elif isinstance(x, SeqVal):
             scores = L.reshape(x.var, [0, -1])  # (B, T)
             # mask padded steps to -inf so top-k never selects padding
             masked = _op("mask_padded_scores",
@@ -705,7 +716,6 @@ def seq_slice_layer(input, starts=None, ends=None, name=None, **kw):
         from paddle_tpu.layer_helper import LayerHelper
         from paddle_tpu.v2.layer import SubSeqVal
 
-        assert isinstance(x, SeqVal)
         k = 0
         sv = ev = None
         if starts is not None:
@@ -713,6 +723,23 @@ def seq_slice_layer(input, starts=None, ends=None, name=None, **kw):
         if ends is not None:
             ev = _unwrap(rest[k]); k += 1
         helper = LayerHelper("seq_slice")
+        if isinstance(x, SubSeqVal):
+            # nested input: starts/ends columns align with the
+            # subsequences — slice each subsequence's window in place
+            # (reference SeqSliceLayer over a nested argument)
+            out = helper.create_tmp_variable(
+                "float32", (-1, -1, -1, input.size or 0))
+            oslen = helper.create_tmp_variable("int32", (-1, -1))
+            ins = {"X": [x.var], "SubLength": [x.sub_lengths]}
+            if sv is not None:
+                ins["Starts"] = [sv]
+            if ev is not None:
+                ins["Ends"] = [ev]
+            helper.append_op(
+                type="padded_subseq_slice", inputs=ins,
+                outputs={"Out": [out], "OutSubLength": [oslen]})
+            return SubSeqVal(out, x.lengths, oslen)
+        assert isinstance(x, SeqVal)
         if multi:
             out = helper.create_tmp_variable(
                 "float32", (-1, -1, -1, input.size or 0))
@@ -1298,9 +1325,29 @@ def cross_entropy_over_beam(input, name=None, **kw):
         parents += [b.candidate_scores, b.gold]
 
     def build(ctx, *vals):
+        from paddle_tpu import layers as L
+        from paddle_tpu.v2.layer import SubSeqVal
+
+        def flat(v, mask_scores=False):
+            # the op contract is (B, n) candidates per expansion; a
+            # nested score tensor compacts its real candidate steps to
+            # the front (so gold indices live in the real-candidate
+            # frame) and masks the padded tail to -inf so it adds no
+            # partition mass to the softmax
+            if isinstance(v, SubSeqVal):
+                v = _v2._flatten_subseq(v)
+            if isinstance(v, SeqVal):
+                row = L.reshape(v.var, [0, -1])
+                if mask_scores:
+                    return _op("mask_padded_scores",
+                               {"X": [row], "Length": [v.lengths]})
+                return row
+            return L.reshape(v, [0, -1])
+
         return _op("cross_entropy_over_beam",
-                   {"Scores": [_unwrap(v) for v in vals[0::2]],
-                    "Golds": [_unwrap(v) for v in vals[1::2]]})
+                   {"Scores": [flat(v, mask_scores=True)
+                               for v in vals[0::2]],
+                    "Golds": [flat(v) for v in vals[1::2]]})
 
     return _simple("cross_entropy_over_beam", parents, build, size=1,
                    name=name)

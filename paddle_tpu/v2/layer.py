@@ -143,10 +143,23 @@ def fc(input, size: int, act=None, param_attr=None, bias_attr=None,
         from paddle_tpu import layers as L
 
         seq_len = None
+        sub_wrap = None
         fluid_ins = []   # (var, num_flatten_dims, size_hint)
         any_seq_in = False
         for v, lo in zip(vals, inputs):
-            if isinstance(v, SeqVal):
+            if isinstance(v, SubSeqVal):
+                # nested sequence: per-inner-step projection over the
+                # trailing feature dim of (B, S, T, D).  Mixing a
+                # nested input with flatter ones is unsupported (the
+                # broadcast/rewrap story is undefined) — fail loudly
+                # rather than dropping the nesting.
+                if len(inputs) > 1:
+                    raise NotImplementedError(
+                        "fc over a nested sequence plus other inputs "
+                        "is not supported; project them separately")
+                fluid_ins.append((v.var, 3, lo.size))
+                sub_wrap = v
+            elif isinstance(v, SeqVal):
                 # the declared v1 layer size is the weight-shape
                 # fallback when a var lost its static feature dim (the
                 # same thing the reference's LayerConfig.size is)
@@ -166,6 +179,9 @@ def fc(input, size: int, act=None, param_attr=None, bias_attr=None,
                        param_attr=param_attr, bias_attr=bias_attr,
                        act=_act_name(act),
                        in_features_hints=[h for _, _, h in fluid_ins])
+            if sub_wrap is not None:
+                return SubSeqVal(out, sub_wrap.lengths,
+                                 sub_wrap.sub_lengths)
             return SeqVal(out, seq_len) if seq_len is not None else out
         # mixed sequence + per-sequence inputs (e.g. a step sequence
         # plus a recurrent memory inside a nested group): project each
@@ -289,6 +305,24 @@ def concat(input: list, name=None, **kwargs):
 # ---------------------------------------------------------------------------
 # sequence layers (padded + mask)
 # ---------------------------------------------------------------------------
+
+
+def _flatten_subseq(x: "SubSeqVal") -> SeqVal:
+    """Pack a padded nested sequence into its plain-sequence view:
+    real inner steps compacted to the front, lengths = total real
+    steps (the subseq_flatten op; shared by pooling, kmax scoring and
+    beam CE so the emission stays in one place)."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    helper = LayerHelper("v1_subseq_flatten")
+    fv = helper.create_tmp_variable("float32", None)
+    fl = helper.create_tmp_variable("int32", (-1,))
+    helper.append_op(
+        type="subseq_flatten",
+        inputs={"X": [x.var], "Length": [x.lengths],
+                "SubLength": [x.sub_lengths]},
+        outputs={"Out": [fv], "OutLength": [fl]})
+    return SeqVal(fv, fl)
 
 
 def _masked(ctx, seq: SeqVal, mode: str):

@@ -54,8 +54,6 @@ PARSE_ONLY = {
         "self-inconsistent feed contract: 'labels' is simultaneously a "
         "CTC id sequence, a 5000-wide huber regression target, and NCE "
         "class ids; the reference only proto-compares",
-    "test_cross_entropy_over_beam.py":
-        "beam CE consumes raw nested-seq wrappers",
 }
 
 # per-config feed-kind overrides where a data layer's sequence level

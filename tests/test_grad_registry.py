@@ -450,6 +450,13 @@ SPECS = {
                 "Ends": [("en", np.array([[2, 3], [3, 2]], np.int64))]},
         attrs={}, output_slots=["Out", "OutLength", "OutSubLength"],
         wrt=["x"], loss_slot="Out"),
+    "padded_subseq_slice": lambda: dict(
+        inputs={"X": [("x", U((2, 2, 4, 2)))],
+                "SubLength": [("s", np.array([[4, 3], [2, 0]], np.int64))],
+                "Starts": [("st", np.array([[0, 1], [1, 0]], np.int64))],
+                "Ends": [("en", np.array([[3, 3], [2, 0]], np.int64))]},
+        attrs={}, output_slots=["Out", "OutSubLength"],
+        wrt=["x"], loss_slot="Out"),
 }
 
 
