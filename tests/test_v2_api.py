@@ -156,3 +156,77 @@ def test_new_datasets_schemas():
     # determinism across calls
     x2, y2 = next(flowers.train()())
     np.testing.assert_array_equal(x, x2)
+
+
+def test_resnet_block_v2_trainer():
+    """The BASELINE.json north-star API path: a residual conv network
+    training end-to-end from ``paddle.v2.trainer.SGD`` (tiny shapes;
+    the full-size throughput row is bench.py/BENCHMARKS.md).  Covers
+    img_conv/batch_norm/img_pool + the residual add through the v2
+    facade with a synthetic separable image task."""
+    import paddle_tpu.v2 as paddle
+
+    paddle.init(use_gpu=False, trainer_count=1)
+    img = paddle.layer.data(name="image",
+                            type=paddle.data_type.dense_vector(3 * 16 * 16))
+
+    def reshape_img(x):
+        from paddle_tpu import layers as L
+        from paddle_tpu.v2.layer import LayerOutput
+
+        def build(ctx, v):
+            return L.reshape(v, [-1, 3, 16, 16])
+
+        return LayerOutput("img4d", [x], build, size=3 * 16 * 16)
+
+    x4 = reshape_img(img)
+    c1 = paddle.layer.img_conv(input=x4, filter_size=3, num_filters=8,
+                               padding=1, act=paddle.activation.Linear())
+    b1 = paddle.layer.batch_norm(input=c1, act=paddle.activation.Relu())
+    c2 = paddle.layer.img_conv(input=b1, filter_size=3, num_filters=8,
+                               padding=1, act=paddle.activation.Linear())
+
+    def residual_add(a, b):
+        from paddle_tpu import layers as L
+        from paddle_tpu.v2.layer import LayerOutput
+
+        def build(ctx, va, vb):
+            return L.relu(L.elementwise_add(va, vb))
+
+        return LayerOutput("res_add", [a, b], build, size=None)
+
+    # shortcut projects 3->8 channels with a 1x1 conv
+    sc = paddle.layer.img_conv(input=x4, filter_size=1, num_filters=8,
+                               act=paddle.activation.Linear())
+    res = residual_add(c2, sc)
+    pool = paddle.layer.img_pool(input=res, pool_size=16, stride=16,
+                                 pool_type=paddle.pooling.Avg())
+    pred = paddle.layer.fc(input=pool, size=4,
+                           act=paddle.activation.Softmax())
+    label = paddle.layer.data(name="label",
+                              type=paddle.data_type.integer_value(4))
+    cost = paddle.layer.classification_cost(input=pred, label=label)
+
+    parameters = paddle.parameters.create(cost)
+    optimizer = paddle.optimizer.Momentum(momentum=0.9, learning_rate=0.3)
+    trainer = paddle.trainer.SGD(cost=cost, parameters=parameters,
+                                 update_equation=optimizer)
+    rng = np.random.RandomState(0)
+    protos = rng.randn(4, 3 * 16 * 16).astype(np.float32)
+
+    def reader():
+        r = np.random.RandomState(1)
+        for _ in range(96):
+            y = int(r.randint(0, 4))
+            yield (protos[y] + 0.3 * r.randn(3 * 16 * 16).astype(np.float32),
+                   y)
+
+    costs = []
+
+    def handler(event):
+        if isinstance(event, paddle.event.EndIteration):
+            costs.append(event.cost)
+
+    trainer.train(reader=paddle.batch(reader, batch_size=16),
+                  num_passes=10, event_handler=handler)
+    assert costs[-1] < 0.5 * costs[0], (costs[0], costs[-1])
