@@ -295,10 +295,11 @@ def lstmemory(input, size=None, reverse=False, act=None, name=None,
 
 
 def grumemory(input, size=None, reverse=False, act=None, name=None,
-              **kwargs):
+              param_attr=None, bias_attr=None, **kwargs):
     # reference grumemory input is the 3h projection
     h = size if size is not None else (input.size // 3 if input.size else None)
-    return _record(_v2.gru(input=input, size=h, reverse=reverse, name=name),
+    return _record(_v2.gru(input=input, size=h, reverse=reverse, name=name,
+                           param_attr=param_attr, bias_attr=bias_attr),
                    "gated_recurrent")
 
 

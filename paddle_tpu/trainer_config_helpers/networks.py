@@ -129,7 +129,7 @@ def gru_group(input, size=None, name=None, reverse=False, act=None,
             "grumemory path always boots from zeros")
     ins = input[0] if isinstance(input, (list, tuple)) else input
     return _l.grumemory(input=ins, size=size, reverse=reverse, act=act,
-                        name=name)
+                        name=name, bias_attr=gru_bias_attr)
 
 
 def bidirectional_gru(input, size, return_seq=False, name=None, **kwargs):

@@ -389,18 +389,23 @@ def lstmemory(input, size: Optional[int] = None, reverse: bool = False,
                        is_seq=True)
 
 
-def gru(input, size: int, reverse: bool = False, name=None, **kwargs):
+def gru(input, size: int, reverse: bool = False, name=None,
+        param_attr=None, bias_attr=None, **kwargs):
     def build(ctx, seq):
         from paddle_tpu.layer_helper import LayerHelper
 
         helper = LayerHelper("v2_gru")
-        w = helper.create_parameter(None, shape=[size, 3 * size], dtype="float32")
-        b = helper.create_parameter(None, shape=[1, 3 * size], dtype="float32",
-                                    is_bias=True)
+        w = helper.create_parameter(param_attr, shape=[size, 3 * size],
+                                    dtype="float32")
+        ins = {"Input": [seq.var], "Weight": [w]}
+        if bias_attr is not False:  # False = no bias, the v1 idiom
+            b = helper.create_parameter(bias_attr, shape=[1, 3 * size],
+                                        dtype="float32", is_bias=True)
+            ins["Bias"] = [b]
         hidden = helper.create_tmp_variable("float32", (-1, -1, size))
         helper.append_op(
             type="gru",
-            inputs={"Input": [seq.var], "Weight": [w], "Bias": [b]},
+            inputs=ins,
             outputs={"Hidden": [hidden]},
             attrs={"is_reverse": reverse})
         return SeqVal(hidden, seq.lengths)
