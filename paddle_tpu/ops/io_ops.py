@@ -116,3 +116,110 @@ def _print(ctx):
 
     io_callback(host_print, jnp.zeros((), jnp.int32), x, ordered=True)
     ctx.set_output("Out", ctx.input("X"))
+
+
+def _grad_printer_grad_lower(ctx):
+    """Print the incoming gradient host-side, pass it through unchanged
+    (reference: GradientPrinter in gserver/evaluators/Evaluator.cpp —
+    evaluated over the *grad* argument of its input layer)."""
+    import numpy as np
+
+    gout = ctx.input("Out@GRAD")
+    message = ctx.op.attr("__fwd_attrs__", {}).get("message", "")
+
+    def host_print(arr):
+        print(f"[grad {message}]", np.asarray(arr), flush=True)
+        return np.int32(0)
+
+    io_callback(host_print, jnp.zeros((), jnp.int32), unwrap(gout),
+                ordered=True)
+    ctx.values[ctx.op.outputs["X@GRAD"][0]] = gout
+
+
+@register_op("grad_printer", inputs=("X",),
+             grad_lower=_grad_printer_grad_lower)
+def _grad_printer(ctx):
+    """Identity on the value path; prints its *gradient* during the
+    backward pass (reference: gradient_printer_evaluator,
+    gserver/evaluators/Evaluator.cpp:1120 ValuePrinter over grads)."""
+    ctx.set_output("Out", ctx.input("X"))
+
+
+# (scope_id, realpath) pairs whose result_file was already truncated
+# this evaluation — see seq_text_printer
+_SEQTEXT_TRUNCATED = set()
+
+
+@register_op("seq_text_printer", inputs=("X", "Id"), stop_gradient=True)
+def _seq_text_printer(ctx):
+    """Write id sequences as dictionary-translated text lines to
+    result_file (reference: seqtext_printer_evaluator,
+    gserver/evaluators/Evaluator.cpp SequenceTextPrinter).  Each line is
+    ``id \\t tokens...`` — the Id input when given, else the sequence
+    index (reference evalImp: ``os_ << (hasId ? sampleIds[i] : i)``)."""
+    from paddle_tpu.lod import LoDArray
+
+    x = ctx.input("X")
+    sample_id = ctx.input("Id") if ctx.op.inputs.get("Id") else None
+    result_file = ctx.attr("result_file")
+    dict_file = ctx.attr("dict_file", None)
+    delimited = ctx.attr("delimited", True)
+
+    words = None
+    if dict_file:
+        with open(dict_file) as f:
+            words = [line.rstrip("\n") for line in f]
+    sep = " " if (delimited is None or delimited) else ""
+
+    def fmt(ids):
+        toks = [(words[i] if words and 0 <= i < len(words) else str(i))
+                for i in ids]
+        return sep.join(toks)
+
+    # reference SequenceTextPrinter truncates once per evaluation
+    # (init opens the ofstream); anchor "evaluation" to the active
+    # executor Scope so recompiles mid-run (shape-keyed jit cache
+    # misses, e.g. a ragged final batch) keep appending, while a fresh
+    # run over a new Scope truncates
+    import os as _os
+
+    import paddle_tpu.executor as _executor_mod
+
+    scope_key = (id(_executor_mod._scope_stack[-1])
+                 if _executor_mod._scope_stack else 0)
+    trunc_key = (scope_key, _os.path.realpath(result_file))
+
+    def host_write(data, lengths, ids_arr):
+        import numpy as np
+
+        data = np.asarray(data)
+        lengths = np.asarray(lengths)
+        ids_arr = np.asarray(ids_arr)
+        lines = []
+        row = 0
+        for k, L in enumerate(lengths):
+            L = int(L)
+            seq = data[row:row + L].reshape(-1).astype(np.int64)
+            row += L
+            # reference evalImp always writes an id column: the Id
+            # input when given, else the sequence index
+            sid = int(ids_arr.reshape(-1)[k]) if ids_arr.size else k
+            lines.append(f"{sid}\t" + fmt(seq.tolist()))
+        mode = "a" if trunc_key in _SEQTEXT_TRUNCATED else "w"
+        _SEQTEXT_TRUNCATED.add(trunc_key)
+        with open(result_file, mode) as f:
+            f.write("\n".join(lines) + ("\n" if lines else ""))
+        return np.int32(0)
+
+    if isinstance(x, LoDArray):
+        data, lengths = x.data, x.seq_lens()
+    else:
+        # dense (N, W): each row is one sample of W tokens
+        xv = unwrap(x)
+        data = xv.reshape(xv.shape[0], -1)
+        lengths = jnp.ones((xv.shape[0],), jnp.int32)
+    ids_val = (unwrap(sample_id).astype(jnp.int64)
+               if sample_id is not None else jnp.zeros((0,), jnp.int64))
+    io_callback(host_write, jnp.zeros((), jnp.int32),
+                data.astype(jnp.int64), lengths, ids_val, ordered=True)
+    ctx.set_output("Out", ctx.input("X"))

@@ -509,6 +509,11 @@ SKIP = {
     "conv3d_transpose_cudnn": "alias of conv3d_transpose (ops/aliases.py)",
     "pool2d_cudnn": "alias of pool2d (ops/aliases.py)",
     "pool3d_cudnn": "alias of pool3d (ops/aliases.py)",
+    # identity with a print side effect in its grad lowering; the
+    # pass-through cotangent is asserted end-to-end in
+    # tests/test_evaluators_tail.py::test_gradient_printer_prints_in_backward
+    "grad_printer": "identity pass-through; printed grad asserted in "
+                    "test_evaluators_tail.py",
     # stochastic loss: negative samples are redrawn each executor step
     # (ctx.rng()), so central differences see a different loss surface;
     # the deterministic forward form is asserted in test_extra_ops
