@@ -328,8 +328,26 @@ def conv2d_transpose(input, num_filters, filter_size, stride=1, padding=0,
     return helper.append_activation(pre_act)
 
 
+def pool_out_extent(size, k, p, s, ceil_mode=False):
+    """Pool output extent along one dim; the single home of the
+    floor/ceil formula (reference: config_parser cnn_output_size with
+    caffe_mode = not ceil_mode).  Returns -1 for unknown input size."""
+    if size is None or size < 0:
+        return -1
+    span = size + 2 * p - k
+    return (-(-span // s) if ceil_mode else span // s) + 1
+
+
+def pool_extra_padding(size, k, p, s):
+    """Extra high-side padding that realises a ceil-mode extent in a
+    floor-mode window reduction."""
+    out = pool_out_extent(size, k, p, s, ceil_mode=True)
+    return max(0, (out - 1) * s + k - (size + 2 * p))
+
+
 def pool2d(input, pool_size=2, pool_type="max", pool_stride=1, pool_padding=0,
-           global_pooling: bool = False, exclusive: bool = False, name=None, **kwargs):
+           global_pooling: bool = False, exclusive: bool = False,
+           ceil_mode: bool = False, name=None, **kwargs):
     helper = LayerHelper("pool2d", name=name, **kwargs)
     ks = pool_size if isinstance(pool_size, (list, tuple)) else (pool_size, pool_size)
     st = pool_stride if isinstance(pool_stride, (list, tuple)) else (pool_stride, pool_stride)
@@ -340,8 +358,8 @@ def pool2d(input, pool_size=2, pool_type="max", pool_stride=1, pool_padding=0,
     else:
         out_shape = (
             n, c,
-            _conv_out_size(h, ks[0], pd[0], st[0]),
-            _conv_out_size(w, ks[1], pd[1], st[1]),
+            pool_out_extent(h, ks[0], pd[0], st[0], ceil_mode),
+            pool_out_extent(w, ks[1], pd[1], st[1], ceil_mode),
         )
     out = helper.create_tmp_variable(input.dtype, out_shape)
     helper.append_op(
@@ -350,7 +368,7 @@ def pool2d(input, pool_size=2, pool_type="max", pool_stride=1, pool_padding=0,
         outputs={"Out": [out]},
         attrs={"pooling_type": pool_type, "ksize": list(ks), "strides": list(st),
                "paddings": list(pd), "global_pooling": global_pooling,
-               "exclusive": exclusive},
+               "exclusive": exclusive, "ceil_mode": ceil_mode},
     )
     return out
 

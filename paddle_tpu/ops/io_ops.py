@@ -177,21 +177,25 @@ def _seq_text_printer(ctx):
         return sep.join(toks)
 
     # reference SequenceTextPrinter truncates once per evaluation
-    # (init opens the ofstream); anchor "evaluation" to the active
-    # executor Scope so recompiles mid-run (shape-keyed jit cache
-    # misses, e.g. a ragged final batch) keep appending, while a fresh
-    # run over a new Scope truncates
+    # (init opens the ofstream); anchor "evaluation" to the executor
+    # Scope ACTIVE AT WRITE TIME (not trace time — the shape-keyed jit
+    # cache can replay one lowering under many scopes), held by weakref
+    # so a recycled id() of a collected Scope can never collide
     import os as _os
 
-    import paddle_tpu.executor as _executor_mod
-
-    scope_key = (id(_executor_mod._scope_stack[-1])
-                 if _executor_mod._scope_stack else 0)
-    trunc_key = (scope_key, _os.path.realpath(result_file))
+    real_path = _os.path.realpath(result_file)
 
     def host_write(data, lengths, ids_arr):
+        import weakref
+
         import numpy as np
 
+        import paddle_tpu.executor as _executor_mod
+
+        scope = (_executor_mod._scope_stack[-1]
+                 if _executor_mod._scope_stack else None)
+        trunc_key = (weakref.ref(scope) if scope is not None else None,
+                     real_path)
         data = np.asarray(data)
         lengths = np.asarray(lengths)
         ids_arr = np.asarray(ids_arr)
@@ -207,6 +211,10 @@ def _seq_text_printer(ctx):
             lines.append(f"{sid}\t" + fmt(seq.tolist()))
         mode = "a" if trunc_key in _SEQTEXT_TRUNCATED else "w"
         _SEQTEXT_TRUNCATED.add(trunc_key)
+        # prune dead-scope keys so the set stays bounded
+        dead = [k for k in _SEQTEXT_TRUNCATED
+                if k[0] is not None and k[0]() is None]
+        _SEQTEXT_TRUNCATED.difference_update(dead)
         with open(result_file, mode) as f:
             f.write("\n".join(lines) + ("\n" if lines else ""))
         return np.int32(0)

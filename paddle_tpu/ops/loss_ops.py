@@ -277,34 +277,83 @@ def _lambda_cost(ctx):
     ctx.set_output("Out", (dcg / max_dcg)[:, None])
 
 
-@register_op("cross_entropy_over_beam", inputs=("Scores", "Golds"),
-             outputs=("Out",))
+@register_op("cross_entropy_over_beam", inputs=("Scores", "Ids", "Golds"),
+             outputs=("Out",), diff_inputs=("Scores",))
 def _cross_entropy_over_beam(ctx):
-    """Cross entropy over beam expansions (reference: gserver/layers/
-    CrossEntropyOverBeam.cpp; v1 cross_entropy_over_beam).  Simplified
-    TPU lowering: each expansion step contributes the NLL of the gold
-    candidate under a softmax over that step's candidate scores; the
-    per-sequence cost is the sum over steps.  (The reference normalizes
-    once over all expanded *paths*; with a single expansion the two are
-    identical, and per-step normalization is the standard globally-
-    normalized-beam-training surrogate.)  Scores: list of (B, C_i);
-    Golds: list of (B, 1) int gold indices."""
-    scores = [unwrap(v) for v in ctx.inputs("Scores")]
-    golds = [unwrap(v) for v in ctx.inputs("Golds")]
-    if len(scores) > 1:
-        import warnings
+    """Cross entropy over beam expansions, globally normalized over all
+    expanded paths (reference: gserver/layers/CrossEntropyOverBeam.cpp
+    CostForOneSequence — calValidExpandStep / constructTotalExpansion /
+    globallyNormalizedScore).
 
-        warnings.warn(
-            "cross_entropy_over_beam: multi-step beams are normalized "
-            "per expansion step here; the reference CrossEntropyOverBeam "
-            "normalizes once over all expanded paths, so the training "
-            "objective differs for multi-step inputs", stacklevel=2)
+    Inputs per expansion step i (lists, one entry per step):
+      Scores: (B, N_i) candidate scores; for i >= 1 the candidate axis
+        is laid out as k_{i-1} parent blocks of C_i = N_i / k_{i-1}
+        candidates each, so candidate c's parent beam slot is c // C_i.
+      Ids:    (B, k_i) candidate indices selected into the beam
+        (kmax output), -1 padded.  Required when there is more than
+        one step — the path set is defined by the beam.
+      Golds:  (B, 1) gold candidate index at that step.
+
+    Reference semantics reproduced exactly:
+      - the valid expansion L per sample is the first step whose beam
+        does not contain the gold (all steps when it never falls off);
+      - the softmax runs once over the scores of all paths alive in
+        expansion L, where a path's score is the SUM of its selected
+        candidates' scores along its ancestry (anc below);
+      - if the gold fell off the beam it joins as an extra path
+        (goldAsExtraPath_); cost = -log p(gold path).
+    """
+    scores = [unwrap(v).astype(jnp.float32) for v in ctx.inputs("Scores")]
+    scores = [s[..., 0] if s.ndim == 3 else s for s in scores]
+    golds = [unwrap(v).reshape(-1).astype(jnp.int32)
+             for v in ctx.inputs("Golds")]
+    ids_named = [n for n in ctx.op.inputs.get("Ids", []) if n]
+    ids = [unwrap(ctx.values[n]).astype(jnp.int32) for n in ids_named]
+    E = len(scores)
     B = scores[0].shape[0]
-    total = jnp.zeros((B,), jnp.float32)
-    for s, g in zip(scores, golds):
-        if s.ndim == 3:
-            s = s[..., 0]
-        logp = jax.nn.log_softmax(s.astype(jnp.float32), axis=-1)
-        gi = g.reshape(B, 1).astype(jnp.int32)
-        total = total - jnp.take_along_axis(logp, gi, axis=1)[:, 0]
-    ctx.set_output("Out", total[:, None])
+    if E > 1 and len(ids) != E:
+        raise ValueError(
+            "cross_entropy_over_beam: multi-step beams need the Ids "
+            "input (one (B, k) selected-candidate tensor per step) to "
+            "define the expanded path set (reference "
+            "CrossEntropyOverBeam.cpp constructTotalExpansion)")
+    if E == 1 and not ids:
+        # beam == all candidates: one softmax over the single expansion
+        logp = jax.nn.log_softmax(scores[0], axis=-1)
+        nll = -jnp.take_along_axis(logp, golds[0][:, None], axis=1)[:, 0]
+        ctx.set_output("Out", nll[:, None])
+        return
+
+    NEG = jnp.float32(-1e30)
+
+    def one(sample_scores, sample_ids, sample_golds):
+        # per-sample; unrolled over the static step count
+        active = jnp.bool_(True)       # gold survived all earlier beams
+        gold_sum = jnp.float32(0.0)    # gold path score so far
+        cost = jnp.float32(0.0)
+        anc_prev = None                # (k_{i-1},) path score per slot
+        for i in range(E):
+            s, g = sample_scores[i], sample_golds[i]
+            sid = sample_ids[i]
+            valid = sid >= 0
+            cand = jnp.where(valid, sid, 0)
+            if anc_prev is None:
+                anc = s[cand]
+            else:
+                cpp = s.shape[0] // anc_prev.shape[0]
+                anc = anc_prev[cand // cpp] + s[cand]
+            anc = jnp.where(valid, anc, NEG)
+            gold_sum = gold_sum + s[g]
+            found = jnp.any(valid & (cand == g))
+            # expansion L = first not-found step, else the last step
+            is_last = active & (~found | jnp.bool_(i == E - 1))
+            paths = jnp.concatenate(
+                [anc, jnp.where(found, NEG, gold_sum)[None]])
+            lse = jax.scipy.special.logsumexp(paths)
+            cost = cost + jnp.where(is_last, lse - gold_sum, 0.0)
+            active = active & found
+            anc_prev = anc
+        return cost
+
+    nll = jax.vmap(one)(scores, ids, golds)
+    ctx.set_output("Out", nll[:, None])

@@ -282,15 +282,28 @@ def _pool3d(ctx):
     pd = tuple(ctx.attr("paddings", (0, 0, 0)))
     if ctx.attr("global_pooling", False):
         ks, st, pd = x.shape[2:5], (1, 1, 1), (0, 0, 0)
+    extra = (0, 0, 0)
+    if ctx.attr("ceil_mode", False):
+        from paddle_tpu.layers.nn import pool_extra_padding
+
+        extra = tuple(pool_extra_padding(x.shape[2 + i], ks[i], pd[i], st[i])
+                      for i in range(3))
     window = (1, 1) + ks
     strides = (1, 1) + st
-    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+    padding = ((0, 0), (0, 0)) + tuple(
+        (p, p + e) for p, e in zip(pd, extra))
     if ctx.attr("pooling_type", "max") == "max":
         out = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, padding)
     else:
         s = lax.reduce_window(x.astype(jnp.float32), 0.0, lax.add, window,
                               strides, padding)
-        out = (s / (ks[0] * ks[1] * ks[2])).astype(x.dtype)
+        if ctx.attr("exclusive", False):
+            ones = jnp.ones_like(x, dtype=jnp.float32)
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, strides,
+                                       padding)
+            out = (s / counts).astype(x.dtype)
+        else:
+            out = (s / (ks[0] * ks[1] * ks[2])).astype(x.dtype)
     ctx.set_output("Out", out)
 
 

@@ -126,9 +126,22 @@ def _pool2d(ctx):
         ksize = x.shape[2:4]
         strides = (1, 1)
         pads = (0, 0)
+    # ceil_mode (reference: config_parser cnn_output_size with
+    # caffe_mode=False, the v1 img_pool default): output extent uses
+    # ceil, implemented as extra high-side padding; windows there are
+    # clipped to the real image exactly like the reference loop bounds
+    # (Matrix.cpp avgPoolForward hend=min(.., imgSize)), because the
+    # extra cells are -inf for max and excluded from avg counts below
+    extra = (0, 0)
+    if ctx.attr("ceil_mode", False):
+        from paddle_tpu.layers.nn import pool_extra_padding
+
+        extra = (pool_extra_padding(x.shape[2], ksize[0], pads[0], strides[0]),
+                 pool_extra_padding(x.shape[3], ksize[1], pads[1], strides[1]))
     window = (1, 1) + ksize
     strides4 = (1, 1) + strides
-    padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    padding = ((0, 0), (0, 0), (pads[0], pads[0] + extra[0]),
+               (pads[1], pads[1] + extra[1]))
     # max/sum windows are separable: two 1-D passes do kh+kw work per
     # output instead of kh*kw (a 32x32 stride-1 pool drops from 1024 to
     # 64 ops/element — the XLA CPU backend at low opt levels does not
@@ -141,11 +154,10 @@ def _pool2d(ctx):
     def _sep(v, init, op):
         h = lax.reduce_window(v, init, op, (1, 1, ksize[0], 1),
                               (1, 1, strides[0], 1),
-                              ((0, 0), (0, 0), (pads[0], pads[0]), (0, 0)))
+                              ((0, 0), (0, 0), padding[2], (0, 0)))
         return lax.reduce_window(h, init, op, (1, 1, 1, ksize[1]),
                                  (1, 1, 1, strides[1]),
-                                 ((0, 0), (0, 0), (0, 0),
-                                  (pads[1], pads[1])))
+                                 ((0, 0), (0, 0), (0, 0), padding[3]))
 
     if ptype == "max":
         init = -jnp.inf

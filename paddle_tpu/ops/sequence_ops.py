@@ -710,6 +710,26 @@ def _sub_nested_seq(ctx):
     ctx.set_output("OutSubLengths", out_sub)
 
 
+@register_op("mask_padded_subseq_scores", inputs=("X", "Length", "SubLength"))
+def _mask_padded_subseq_scores(ctx):
+    """Mask a padded nested score tensor (B, S, T) to -1e9 on padding
+    (rows past Length, inner steps past SubLength) and flatten to
+    (B, S*T) — the padded-beam frame cross_entropy_over_beam consumes
+    (candidate slot c's parent beam row is c // T, which only holds in
+    the *padded*, non-compacted layout)."""
+    x = unwrap(ctx.input("X"))
+    if x.ndim == 4 and x.shape[-1] == 1:
+        x = x[..., 0]
+    lens = unwrap(ctx.input("Length")).reshape(-1).astype(jnp.int32)
+    sub = unwrap(ctx.input("SubLength")).astype(jnp.int32)   # (B, S)
+    B, S, T = x.shape
+    row_ok = jnp.arange(S)[None, :] < lens[:, None]          # (B, S)
+    step_ok = jnp.arange(T)[None, None, :] < sub[:, :, None]  # (B, S, T)
+    ok = row_ok[:, :, None] & step_ok
+    out = jnp.where(ok, x, jnp.asarray(-1e9, x.dtype))
+    ctx.set_output("Out", out.reshape(B, S * T))
+
+
 @register_op("mask_padded_scores", inputs=("X", "Length"))
 def _mask_padded_scores(ctx):
     """Set scores past each sequence's length to -inf so top-k/argmax

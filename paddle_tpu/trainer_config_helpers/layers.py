@@ -69,6 +69,9 @@ def _record(lo: LayerOutput, type_: str, **cfg):
                  "inputs": [p.name for p in lo.parents]}
         entry.update(cfg)
         _g_capture.setdefault("layers", []).append(entry)
+        # owners may amend their own entry later (pad geometry, network
+        # helpers retyping a transform) without name-keyed scans
+        lo._cfg_entry = entry
     return lo
 
 
@@ -214,6 +217,31 @@ def _out_hw(h, w, k, s, p):
     return (oh, ow) if oh > 0 and ow > 0 else (0, 0)
 
 
+def _out_hw_ceil(h, w, k, s, p):
+    """Pool output extent with ceil rounding (reference: config_parser
+    cnn_output_size with caffe_mode=False — the v1 pool default).
+    Delegates to the single formula home in paddle_tpu.layers.nn."""
+    from paddle_tpu.layers.nn import pool_out_extent
+
+    if not h or not w:
+        return 0, 0
+    (kh, kw), (sh, sw), (ph, pw) = _pair_hw(k), _pair_hw(s), _pair_hw(p)
+    oh = pool_out_extent(h, kh, ph, sh, ceil_mode=True)
+    ow = pool_out_extent(w, kw, pw, sw, ceil_mode=True)
+    return (oh, ow) if oh > 0 and ow > 0 else (0, 0)
+
+
+def _deconv_out_hw(h, w, k, s, p):
+    """Transposed-conv output extent (reference: config_parser
+    cnn_image_size — the inverse of cnn_output_size)."""
+    if not h or not w:
+        return 0, 0
+    (kh, kw), (sh, sw), (ph, pw) = _pair_hw(k), _pair_hw(s), _pair_hw(p)
+    oh = (h - 1) * sh + kh - 2 * ph
+    ow = (w - 1) * sw + kw - 2 * pw
+    return (oh, ow) if oh > 0 and ow > 0 else (0, 0)
+
+
 def _parent_geom(parent, num_channels):
     """(c, h, w) of a layer consumed as an image, from declared
     geometry or the square-size heuristic (reference config_parser
@@ -229,11 +257,24 @@ def _parent_geom(parent, num_channels):
 
 def img_conv_layer(input, filter_size, num_filters, num_channels=None,
                    stride=1, padding=0, act=None, param_attr=None,
-                   bias_attr=None, groups=1, name=None, **kwargs):
+                   bias_attr=None, groups=1, trans=False, name=None,
+                   **kwargs):
     def build(ctx, x):
         from paddle_tpu import layers as L
 
         x = _to_image(ctx, x, input, num_channels)
+        if trans:
+            if groups != 1:
+                raise NotImplementedError(
+                    "img_conv_layer(trans=True) does not support "
+                    "groups != 1 (the fluid conv2d_transpose has no "
+                    "grouped path); reference ConvTransLayer supports "
+                    "it — open a gap if a config needs it")
+            return L.conv2d_transpose(
+                input=x, num_filters=num_filters, filter_size=filter_size,
+                stride=stride, padding=padding,
+                act=(act.name if act else None),
+                param_attr=param_attr, bias_attr=bias_attr)
         return L.conv2d(input=x, num_filters=num_filters,
                         filter_size=filter_size, stride=stride,
                         padding=padding, groups=groups,
@@ -241,29 +282,42 @@ def img_conv_layer(input, filter_size, num_filters, num_channels=None,
                         param_attr=param_attr, bias_attr=bias_attr)
 
     _, h, w = _parent_geom(input, num_channels)
-    oh, ow = _out_hw(h, w, filter_size, stride, padding)
+    if trans:
+        # deconv extent (reference config_parser cnn_image_size:
+        # img = (output - 1) * stride + filter - 2 * pad)
+        oh, ow = _deconv_out_hw(h, w, filter_size, stride, padding)
+    else:
+        oh, ow = _out_hw(h, w, filter_size, stride, padding)
     lo = LayerOutput(name or _v2._uname("conv"), [input], build,
                      size=(num_filters * oh * ow) or num_filters)
     lo.num_channels = num_filters
     lo.img_shape = (None, oh, ow) if oh else None
-    return _record(lo, "exconv", num_filters=num_filters,
-                   filter_size=filter_size)
+    return _record(lo, "exconvt" if trans else "exconv",
+                   num_filters=num_filters, filter_size=filter_size)
 
 
 def img_pool_layer(input, pool_size, stride=1, padding=0, pool_type=None,
-                   num_channels=None, name=None, **kwargs):
+                   num_channels=None, ceil_mode=True, exclude_mode=None,
+                   name=None, **kwargs):
     ptype = pool_type.name if isinstance(pool_type, BasePoolingType) else (
         pool_type or "max")
+    # reference defaults: ceil output extents (img_pool_layer
+    # ceil_mode=True; config_parser cnn_output_size caffe_mode=False)
+    # and exclude-mode averaging (PoolLayer.cpp:49 excludeMode_
+    # defaults true: divide by the count of real-image cells)
+    exclusive = True if exclude_mode is None else bool(exclude_mode)
 
     def build(ctx, x):
         from paddle_tpu import layers as L
 
         x = _to_image(ctx, x, input, num_channels)
         return L.pool2d(input=x, pool_size=pool_size, pool_type=ptype,
-                        pool_stride=stride, pool_padding=padding)
+                        pool_stride=stride, pool_padding=padding,
+                        ceil_mode=ceil_mode, exclusive=exclusive)
 
     c, h, w = _parent_geom(input, num_channels)
-    oh, ow = _out_hw(h, w, pool_size, stride, padding)
+    oh, ow = (_out_hw_ceil if ceil_mode else _out_hw)(
+        h, w, pool_size, stride, padding)
     lo = LayerOutput(name or _v2._uname("pool"), [input], build,
                      size=(c * oh * ow) or input.size)
     lo.num_channels = c
@@ -273,9 +327,14 @@ def img_pool_layer(input, pool_size, stride=1, padding=0, pool_type=None,
 
 def batch_norm_layer(input, act=None, name=None, num_channels=None,
                      use_global_stats=None, **kwargs):
+    # the v1 default activation is ReLU (reference layers.py:3148
+    # @wrap_act_default(act=ReluActivation()) on batch_norm_layer)
+    from paddle_tpu.trainer_config_helpers.activations import ReluActivation
+
+    act = act or ReluActivation()
     lo = _v2.batch_norm(input=input, act=act, name=name)
     lo.num_channels = getattr(input, "num_channels", num_channels)
-    return _record(lo, "batch_norm")
+    return _record(lo, "batch_norm", active_type=act.name)
 
 
 def dropout_layer(input, dropout_rate: float, name=None, **kwargs):
@@ -291,7 +350,8 @@ def dropout_layer(input, dropout_rate: float, name=None, **kwargs):
 def lstmemory(input, size=None, reverse=False, act=None, name=None,
               **kwargs):
     return _record(_v2.lstmemory(input=input, size=size, reverse=reverse,
-                                 act=act, name=name), "lstmemory")
+                                 act=act, name=name), "lstmemory",
+                   active_type=(act.name if act else "tanh"))
 
 
 def grumemory(input, size=None, reverse=False, act=None, name=None,
@@ -300,14 +360,16 @@ def grumemory(input, size=None, reverse=False, act=None, name=None,
     h = size if size is not None else (input.size // 3 if input.size else None)
     return _record(_v2.gru(input=input, size=h, reverse=reverse, name=name,
                            param_attr=param_attr, bias_attr=bias_attr),
-                   "gated_recurrent")
+                   "gated_recurrent",
+                   active_type=(act.name if act else "tanh"))
 
 
 def recurrent_layer(input, size=None, act=None, reverse=False, name=None,
                     **kwargs):
     h = size if size is not None else input.size
     return _record(_v2.simple_rnn(input=input, size=h, act=act,
-                                  reverse=reverse, name=name), "recurrent")
+                                  reverse=reverse, name=name), "recurrent",
+                   active_type=(act.name if act else "tanh"))
 
 
 # ---------------------------------------------------------------------------
@@ -378,7 +440,11 @@ def pooling_layer(input, pooling_type: Optional[BasePoolingType] = None,
     is_seq_out = to_seq or (stride and stride > 0 and not max_index)
     lo = LayerOutput(name or _v2._uname("seqpool"), [input], build,
                      size=input.size, is_seq=bool(is_seq_out))
-    return _record(lo, "seqpool")
+    # proto type is the pooling strategy (reference SequencePoolLayer
+    # subclasses register as "max" / "average"; sum is AverageLayer in
+    # sum mode, also type "average")
+    proto_type = "max" if ptype == "max" else "average"
+    return _record(lo, proto_type)
 
 
 def last_seq(input, name=None, **kwargs):
@@ -431,14 +497,20 @@ def expand_layer(input, expand_as, expand_level="non-seq", name=None,
     return _record(lo, "expand")
 
 
-def repeat_layer(input, num_repeats: int, name=None, **kwargs):
+def repeat_layer(input, num_repeats: int, act=None, name=None, **kwargs):
     def build(ctx, x):
-        return _op("expand", {"X": [x]},
-                   attrs={"expand_times": [1, num_repeats]})
+        out = _op("expand", {"X": [x]},
+                  attrs={"expand_times": [1, num_repeats]})
+        if act and act.name and act.name != "linear":
+            from paddle_tpu import layers as L
+
+            out = getattr(L, act.name)(out)
+        return out
 
     lo = LayerOutput(name or _v2._uname("repeat"), [input], build,
                      size=(input.size or 0) * num_repeats)
-    return _record(lo, "featmap_expand")
+    return _record(lo, "featmap_expand",
+                   active_type=(act.name if act else ""))
 
 
 # ---------------------------------------------------------------------------
@@ -447,17 +519,35 @@ def repeat_layer(input, num_repeats: int, name=None, **kwargs):
 
 
 def concat_layer(input: list, name=None, **kwargs):
+    had_proj = any(not isinstance(i, LayerOutput) for i in input)
+    helper_entries = []
+    proj_sources = []
+
     def as_layer(i):
         if isinstance(i, LayerOutput):
+            proj_sources.append(i.name)
             return i
         # a projection (identity_projection(...) etc): evaluate it in a
-        # one-projection mixed layer (reference ConcatProjectionLayer)
+        # one-projection mixed layer
         with mixed_layer() as m:
             m += i
+        if getattr(m._lo, "_cfg_entry", None) is not None:
+            helper_entries.append(m._lo._cfg_entry)
+        proj_sources.append(getattr(getattr(i, "input", None), "name",
+                                    m._lo.name))
         return m._lo
 
-    return _record(_v2.concat(input=[as_layer(i) for i in input],
-                              name=name), "concat")
+    lo = _v2.concat(input=[as_layer(i) for i in input], name=name)
+    if not had_proj:
+        return _record(lo, "concat")
+    # projection form: the reference emits ConcatenateLayer2 ("concat2")
+    # taking the projection sources directly; fold the helper mixed
+    # wrappers out of the capture (removed by entry identity, not name)
+    if _g_capture is not None:
+        drop = {id(e) for e in helper_entries}
+        _g_capture["layers"] = [
+            e for e in _g_capture.get("layers", []) if id(e) not in drop]
+    return _record(lo, "concat2", inputs=proj_sources)
 
 
 def addto_layer(input, act=None, bias_attr=None, name=None, **kwargs):
@@ -779,7 +869,20 @@ def pad_layer(input, pad_c=None, pad_h=None, pad_w=None, name=None,
             paddings.extend(dim_pad)
         return _op("pad", {"X": [x]}, attrs={"paddings": paddings})
 
-    return _unary("pad", build, input)
+    lo = _unary("pad", build, input)
+    # padded geometry (reference PadLayer: size = (c+pc)*(h+ph)*(w+pw))
+    c = getattr(input, "num_channels", None)
+    geom = getattr(input, "img_shape", None)
+    if c and geom and geom[1]:
+        pc, ph, pw = (sum(pad_c or [0]), sum(pad_h or [0]),
+                      sum(pad_w or [0]))
+        oc, oh, ow = c + pc, geom[1] + ph, geom[2] + pw
+        lo.num_channels = oc
+        lo.img_shape = (None, oh, ow)
+        lo.size = oc * oh * ow
+        if getattr(lo, "_cfg_entry", None) is not None:
+            lo._cfg_entry["size"] = lo.size
+    return lo
 
 
 def cos_sim(a, b, scale: float = 1.0, size: int = 1, name=None, **kwargs):
@@ -791,7 +894,7 @@ def cos_sim(a, b, scale: float = 1.0, size: int = 1, name=None, **kwargs):
         return L.scale(_op("cos_sim", {"X": [xv], "Y": [yv]}), scale=scale)
 
     lo = LayerOutput(name or _v2._uname("cos_sim"), [a, b], build, size=size)
-    return _record(lo, "cos")
+    return _record(lo, "cos_vm" if (size or 1) > 1 else "cos")
 
 
 def maxid_layer(input, name=None, **kwargs):
@@ -952,7 +1055,7 @@ def crf_layer(input, label, size=None, param_attr=None, name=None, **kwargs):
         return L.mean(ll)
 
     lo = LayerOutput(name or _v2._uname("crf"), [input, label], build, size=1)
-    return _record(lo, "crf")
+    return _record(lo, "crf", size=d)
 
 
 def crf_decoding_layer(input, size=None, label=None, param_attr=None,
@@ -1012,7 +1115,7 @@ def nce_layer(input, label, num_classes: int = None,
 
     parents = [input, label] + ([weight] if weight is not None else [])
     lo = LayerOutput(name or _v2._uname("nce"), parents, build, size=1)
-    return _record(lo, "nce")
+    return _record(lo, "nce", active_type="sigmoid")
 
 
 def warp_ctc_layer(input, label, size=None, blank=0, norm_by_times=False,
@@ -1041,10 +1144,20 @@ def warp_ctc_layer(input, label, size=None, blank=0, norm_by_times=False,
 
     lo = LayerOutput(name or _v2._uname("warp_ctc"), [input, label], build,
                      size=1)
-    return _record(lo, "warp_ctc")
+    # proto size = category count + 1 for the blank (reference
+    # layers.py ctc_layer: size = label.size + 1)
+    return _record(lo, kwargs.get("_proto_type", "warp_ctc"),
+                   size=(size or (label.size + 1 if label.size
+                                  else input.size)))
 
 
-ctc_layer = warp_ctc_layer  # CTCLayer.cpp shares the contract
+def ctc_layer(input, label, size=None, blank=0, norm_by_times=False,
+              name=None, **kwargs):
+    """v1 ctc_layer (reference CTCLayer.cpp shares the warp-ctc
+    contract; distinct proto type "ctc")."""
+    return warp_ctc_layer(input, label, size=size, blank=blank,
+                          norm_by_times=norm_by_times, name=name,
+                          _proto_type="ctc", **kwargs)
 
 
 def hsigmoid_layer(input, label, num_classes, param_attr=None,

@@ -48,10 +48,14 @@ def _unwrap(v):
 
 
 def _simple(name_prefix, parents, build, size=None, is_seq=False,
-            type_=None, name=None):
+            type_=None, name=None, **cfg):
     lo = LayerOutput(name or _v2._uname(name_prefix), list(parents), build,
                      size=size, is_seq=is_seq)
-    return _record(lo, type_ or name_prefix)
+    if "proto_size" in cfg:
+        # captured proto size differs from the runtime LayerOutput size
+        # (e.g. the reference leaves cost-layer sizes unset)
+        cfg["size"] = cfg.pop("proto_size")
+    return _record(lo, type_ or name_prefix, **cfg)
 
 
 def _rewrap_like(parent_val, out):
@@ -144,7 +148,10 @@ def roi_pool_layer(input, rois, pooled_width, pooled_height,
                     "spatial_scale": float(spatial_scale)},
                    out_slot="Out")
 
-    return _simple("roi_pool", [input, rois], build, name=name)
+    c = getattr(input, "num_channels", None)
+    return _simple("roi_pool", [input, rois], build,
+                   size=(c * int(pooled_height) * int(pooled_width))
+                   if c else None, name=name)
 
 
 def row_conv_layer(input, context_len: int, act=None, param_attr=None,
@@ -157,10 +164,15 @@ def row_conv_layer(input, context_len: int, act=None, param_attr=None,
                                     shape=[context_len, input.size],
                                     dtype="float32")
         out = _op("row_conv", {"X": [_unwrap(x)], "Filter": [w]})
+        if act and act.name and act.name != "linear":
+            from paddle_tpu import layers as L
+
+            out = getattr(L, act.name)(out)
         return _rewrap_like(x, out)
 
     return _simple("row_conv", [input], build, size=input.size,
-                   is_seq=input.is_seq, name=name)
+                   is_seq=input.is_seq, name=name,
+                   active_type=(act.name if act else ""))
 
 
 def multiplex_layer(input, name=None, **kw):
@@ -177,7 +189,8 @@ def sampling_id_layer(input, name=None, **kw):
     def build(ctx, x):
         return _op("sampling_id", {"X": [_unwrap(x)]}, dtype="int64")
 
-    return _simple("sampling_id", [input], build, size=1, name=name)
+    return _simple("sampling_id", [input], build, size=input.size,
+                   name=name)
 
 
 def crop_layer(input, offset=None, shape=None, axis=2, name=None, **kw):
@@ -220,7 +233,7 @@ def rank_cost(left, right, label, weight=None, name=None, **kw):
         return L.mean(out)
 
     return _simple("rank_cost", [left, right, label], build, size=1,
-                   name=name)
+                   type_="rank-cost", name=name)
 
 
 def smooth_l1_cost(input, label, name=None, coeff=1.0, **kw):
@@ -364,6 +377,7 @@ def linear_comb_layer(weights, vectors, size=None, name=None, **kw):
         return L.reduce_sum(L.elementwise_mul(vv, wv, axis=0), dim=1)
 
     return _simple("linear_comb", [weights, vectors], build, size=out_size,
+                   type_="convex_comb",
                    name=name)
 
 
@@ -435,12 +449,12 @@ def kmax_seq_score_layer(input, beam_size=1, name=None, **kw):
             # nested scores (B, S, T, 1): rank candidates across every
             # inner step of the sample's beam (reference
             # KmaxSeqScoreLayer over a nested input scores each
-            # subsequence's steps; the flat top-k view is the padded
-            # equivalent), padding masked via the flattened lengths
-            flat = _v2._flatten_subseq(x)
-            scores = _op("mask_padded_scores",
-                         {"X": [L.reshape(flat.var, [0, -1])],
-                          "Length": [flat.lengths]})
+            # subsequence's steps); the PADDED (B, S*T) frame keeps
+            # candidate c's parent row recoverable as c // T, which
+            # cross_entropy_over_beam's path reconstruction needs
+            scores = _op("mask_padded_subseq_scores",
+                         {"X": [x.var], "Length": [x.lengths],
+                          "SubLength": [x.sub_lengths]})
         elif isinstance(x, SeqVal):
             scores = L.reshape(x.var, [0, -1])  # (B, T)
             # mask padded steps to -inf so top-k never selects padding
@@ -455,7 +469,7 @@ def kmax_seq_score_layer(input, beam_size=1, name=None, **kw):
                   out_slot="Indices", dtype="int64")
         return ids
 
-    return _simple("kmax_seq_score", [input], build, size=beam_size,
+    return _simple("kmax_seq_score", [input], build, size=None,
                    name=name)
 
 
@@ -483,17 +497,28 @@ def gated_unit_layer(input, size, act=None, gate_attr=None,
                      gate_param_attr=None, gate_bias_attr=None,
                      inproj_attr=None, inproj_param_attr=None,
                      inproj_bias_attr=None, name=None, **kw):
-    def build(ctx, x):
+    """input_proj(act) * gate(sigmoid) (reference layers.py:6755
+    gated_unit_layer — decomposes to two fc layers and a dotmul mixed,
+    the structure the protostr golden records)."""
+    from paddle_tpu.trainer_config_helpers.activations import \
+        SigmoidActivation
+    from paddle_tpu.trainer_config_helpers.layers import fc_layer
+
+    proj = fc_layer(input=input, size=size, act=act,
+                    param_attr=inproj_param_attr,
+                    bias_attr=inproj_bias_attr,
+                    name=name and name + "_input_proj")
+    gate = fc_layer(input=input, size=size, act=SigmoidActivation(),
+                    param_attr=gate_param_attr, bias_attr=gate_bias_attr,
+                    name=name and name + "_gate")
+
+    def build(ctx, p, g):
         from paddle_tpu import layers as L
 
-        xv = _unwrap(x)
-        proj = L.fc(input=xv, size=size, param_attr=inproj_param_attr,
-                    bias_attr=inproj_bias_attr)
-        gate = L.fc(input=xv, size=size, act="sigmoid",
-                    param_attr=gate_param_attr, bias_attr=gate_bias_attr)
-        return L.elementwise_mul(proj, gate)
+        return L.elementwise_mul(_unwrap(p), _unwrap(g))
 
-    return _simple("gated_unit", [input], build, size=size, name=name)
+    return _simple("gated_unit", [proj, gate], build, size=size,
+                   type_="mixed", name=name)
 
 
 def selective_fc_layer(input, size, select=None, act=None, param_attr=None,
@@ -525,7 +550,10 @@ def spp_layer(input, pyramid_height=3, num_channels=None, pool_type=None,
             outs.append(L.reshape(p, [-1, B_C_H_W[1] * bins * bins]))
         return L.concat(outs, axis=1)
 
-    return _simple("spp", [input], build, name=name)
+    c = getattr(input, "num_channels", num_channels)
+    total_bins = sum((2 ** l) ** 2 for l in range(int(pyramid_height)))
+    return _simple("spp", [input], build,
+                   size=(c * total_bins) if c else None, name=name)
 
 
 def bilinear_interp_layer(input, out_size_x, out_size_y, num_channels=None,
@@ -539,7 +567,13 @@ def bilinear_interp_layer(input, out_size_x, out_size_y, num_channels=None,
         out.shape = (-1, c, int(out_size_y), int(out_size_x))
         return out
 
-    return _simple("bilinear_interp", [input], build, name=name)
+    c = getattr(input, "num_channels", num_channels)
+    lo = _simple("bilinear_interp", [input], build,
+                 size=(c * int(out_size_y) * int(out_size_x))
+                 if c else None, name=name)
+    lo.num_channels = c
+    lo.img_shape = (None, int(out_size_y), int(out_size_x))
+    return lo
 
 
 # -- detection wrappers (fluid detection layers underneath) ------------------
@@ -647,7 +681,9 @@ def multibox_loss_layer(input_loc, input_conf, priorbox, label, gt_box=None,
 
     parents = [input_loc, input_conf, priorbox, label] + (
         [gt_box] if gt_box is not None else [])
-    return _simple("multibox_loss", parents, build, size=1, name=name)
+    return _simple("multibox_loss", parents, build, size=1, name=name,
+                   inputs=[priorbox.name, label.name, input_loc.name,
+                           input_conf.name])
 
 
 def detection_output_layer(input_loc, input_conf, priorbox, num_classes,
@@ -679,7 +715,9 @@ def detection_output_layer(input_loc, input_conf, priorbox, num_classes,
                                 background_label=background_id)
 
     return _simple("detection_output", [input_loc, input_conf, priorbox],
-                   build, name=name)
+                   build, size=int(keep_top_k) * 7, name=name,
+                   inputs=[priorbox.name, input_loc.name,
+                           input_conf.name])
 
 
 # -- sequence wrappers -------------------------------------------------------
@@ -699,6 +737,7 @@ def seq_concat_layer(a, b, name=None, **kw):
         return SeqVal(out, lens) if lens is not None else out
 
     return _simple("seq_concat", [a, b], build, size=a.size, is_seq=True,
+                   type_="seqconcat",
                    name=name)
 
 
@@ -786,6 +825,7 @@ def seq_reshape_layer(input, reshape_size, name=None, **kw):
         return L.reshape(xv, [0, -1, int(reshape_size)])
 
     return _simple("seq_reshape", [input], build, size=reshape_size,
+                   type_="seqreshape",
                    is_seq=True, name=name)
 
 
@@ -804,7 +844,7 @@ def print_layer(input, format=None, name=None, **kw):
         return _rewrap_like(first, out)
 
     return _simple("print", list(inputs), build, size=inputs[0].size,
-                   is_seq=inputs[0].is_seq, name=name)
+                   is_seq=inputs[0].is_seq, name=name, proto_size=None)
 
 
 printer_layer = print_layer
@@ -995,6 +1035,32 @@ def dotmul_operator(a, b, scale=1.0, **kw):
 # -- 3-D image layers (ops conv3d / pool3d exist) ----------------------------
 
 
+
+def _triple2(v):
+    return [v] * 3 if isinstance(v, int) else list(v)
+
+
+def _geom3d(parent, num_channels):
+    """(c, d, h, w) of a 3-D image parent, or Nones (reference:
+    config_parser parse_image3d bookkeeping via height/width/depth)."""
+    c = num_channels or getattr(parent, "num_channels", None)
+    geom = getattr(parent, "img_shape", None)
+    d = getattr(parent, "img_depth", None)
+    if geom and geom[1] and d:
+        return c, d, geom[1], geom[2]
+    return c, None, None, None
+
+
+def _conv3d_out(sz, k, s, p):
+    return (sz + 2 * p - k) // s + 1
+
+
+def _pool3d_out(sz, k, s, p):
+    from paddle_tpu.layers.nn import pool_out_extent
+
+    return pool_out_extent(sz, k, p, s, ceil_mode=True)
+
+
 def img_conv3d_layer(input, filter_size, num_filters, num_channels=None,
                      stride=1, padding=0, act=None, param_attr=None,
                      bias_attr=None, name=None, shape=None, trans=False,
@@ -1024,8 +1090,28 @@ def img_conv3d_layer(input, filter_size, num_filters, num_channels=None,
         return _op("conv3d", {"Input": [xv], "Filter": [w]},
                    attrs, out_slot="Output")
 
-    return _simple("deconv3d" if trans else "conv3d", [input], build,
-                   name=name)
+    c, d, h, w = _geom3d(input, num_channels)
+    size = None
+    if d:
+        ks3 = _triple2(filter_size)
+        st3 = _triple2(stride)
+        pd3 = _triple2(padding)
+        if trans:
+            od, oh, ow = ((d - 1) * st3[0] + ks3[0] - 2 * pd3[0],
+                          (h - 1) * st3[1] + ks3[1] - 2 * pd3[1],
+                          (w - 1) * st3[2] + ks3[2] - 2 * pd3[2])
+        else:
+            od, oh, ow = (_conv3d_out(d, ks3[0], st3[0], pd3[0]),
+                          _conv3d_out(h, ks3[1], st3[1], pd3[1]),
+                          _conv3d_out(w, ks3[2], st3[2], pd3[2]))
+        size = num_filters * od * oh * ow
+    lo = _simple("deconv3d" if trans else "conv3d", [input], build,
+                 size=size, name=name)
+    if size:
+        lo.num_channels = num_filters
+        lo.img_shape = (None, oh, ow)
+        lo.img_depth = od
+    return lo
 
 
 def img_pool3d_layer(input, pool_size, stride=None, padding=0,
@@ -1040,13 +1126,33 @@ def img_pool3d_layer(input, pool_size, stride=None, padding=0,
         ptype = "avg" if "avg" in ptype.lower() else "max"
 
     def build(ctx, x):
+        # v1 defaults: ceil extents + exclude-mode averaging, same as
+        # the 2-D pool (reference parse_pool3d ceil, PoolLayer.cpp:49)
         return _op("pool3d", {"X": [_as_image(x, input, num_channels,
                                               want_depth=True)]},
                    {"ksize": _triple(pool_size),
                     "strides": _triple(stride or pool_size),
-                    "paddings": _triple(padding), "pooling_type": ptype})
+                    "paddings": _triple(padding), "pooling_type": ptype,
+                    "ceil_mode": True, "exclusive": True})
 
-    return _simple("pool3d", [input], build, name=name)
+    c, d, h, w = _geom3d(input, num_channels)
+    size = None
+    if d and c:
+        ks3 = _triple2(pool_size)
+        st3 = _triple2(stride or pool_size)
+        pd3 = _triple2(padding)
+        # v1 pools use ceil extents (reference img_pool3d_layer
+        # ceil_mode=True -> cnn_output_size caffe_mode=False)
+        od, oh, ow = (_pool3d_out(d, ks3[0], st3[0], pd3[0]),
+                      _pool3d_out(h, ks3[1], st3[1], pd3[1]),
+                      _pool3d_out(w, ks3[2], st3[2], pd3[2]))
+        size = c * od * oh * ow
+    lo = _simple("pool3d", [input], build, size=size, name=name)
+    if size:
+        lo.num_channels = c
+        lo.img_shape = (None, oh, ow)
+        lo.img_depth = od
+    return lo
 
 
 def scale_sub_region_layer(input, indices, value, name=None, **kw):
@@ -1109,7 +1215,9 @@ def cross_entropy_with_selfnorm(input, label, softmax_selfnorm_alpha=0.1,
                   {"scale": float(softmax_selfnorm_alpha)})
         return L.mean(L.elementwise_add(ce, pen))
 
-    return _simple("ce_selfnorm", [input, label], build, size=1, name=name)
+    return _simple("ce_selfnorm", [input, label], build, size=1,
+                   type_="multi_class_cross_entropy_with_selfnorm",
+                   name=name, proto_size=None)
 
 
 class BaseGeneratedInput:
@@ -1251,7 +1359,7 @@ def conv_projection(input, filter_size, num_filters, num_channels=None,
 
 def conv_operator(img, filter, filter_size, num_filters,
                   num_channels=None, stride=1, padding=0, filter_size_y=None,
-                  stride_y=None, padding_y=None, **kw):
+                  stride_y=None, padding_y=None, trans=False, **kw):
     """Conv whose FILTER comes from another layer (reference
     ConvOperator in mixed_layer — used for attention-style dynamic
     filters).  `filter`'s output supplies num_filters*C*kh*kw weights
@@ -1269,18 +1377,47 @@ def conv_operator(img, filter, filter_size, num_filters,
         fv = L.reshape(_unwrap(f), [-1, num_filters, c, int(fh), int(fw)])
         f0 = _op("slice_tensor", {"X": [fv]},
                  {"starts": [0], "ends": [1], "axes": [0]})
-        f2 = L.reshape(f0, [num_filters, c, int(fh), int(fw)])
-        out = _op("conv2d", {"Input": [imgv], "Filter": [f2]},
-                  {"strides": [stride, stride_y or stride],
-                   "paddings": [padding, padding_y or padding],
-                   "dilations": [1, 1], "groups": 1}, out_slot="Output")
+        if trans:
+            f2 = L.reshape(f0, [c, num_filters, int(fh), int(fw)])
+            out = _op("conv2d_transpose", {"Input": [imgv], "Filter": [f2]},
+                      {"strides": [stride, stride_y or stride],
+                       "paddings": [padding, padding_y or padding],
+                       "dilations": [1, 1]}, out_slot="Output")
+        else:
+            f2 = L.reshape(f0, [num_filters, c, int(fh), int(fw)])
+            out = _op("conv2d", {"Input": [imgv], "Filter": [f2]},
+                      {"strides": [stride, stride_y or stride],
+                       "paddings": [padding, padding_y or padding],
+                       "dilations": [1, 1], "groups": 1}, out_slot="Output")
         _, _, h, w_ = imgv.shape
-        oh = (int(h) + 2 * padding - int(fh)) // stride + 1
-        ow = (int(w_) + 2 * (padding_y or padding) - int(fw)) // (
-            stride_y or stride) + 1
+        oh, ow = _conv_op_out_hw(int(h), int(w_))
         return L.reshape(out, [-1, num_filters * oh * ow])
 
-    return _simple("conv_op", [img, filter], build)
+    def _conv_op_out_hw(h, w_):
+        sy = stride_y or stride
+        py = padding_y if padding_y is not None else padding
+        if trans:
+            return ((h - 1) * stride + int(fh) - 2 * padding,
+                    (w_ - 1) * sy + int(fw) - 2 * py)
+        return ((h + 2 * padding - int(fh)) // stride + 1,
+                (w_ + 2 * py - int(fw)) // sy + 1)
+
+    # declared size from the image geometry (square sqrt fallback like
+    # reference parse_conv when the data layer declares no height)
+    import math as _math
+
+    c0 = num_channels or getattr(img, "num_channels", None) or 1
+    geom = getattr(img, "img_shape", None)
+    if geom and geom[1]:
+        h0, w0 = geom[1], geom[2]
+    else:
+        side = int(_math.isqrt((img.size or 0) // c0))
+        h0 = w0 = side if side * side * c0 == (img.size or 0) else None
+    size = None
+    if h0:
+        oh0, ow0 = _conv_op_out_hw(h0, w0)
+        size = num_filters * oh0 * ow0
+    return _simple("conv_op", [img, filter], build, size=size)
 
 
 # -- LambdaRank / beam-training costs (the last v1 name gaps) ---------------
@@ -1322,32 +1459,43 @@ def cross_entropy_over_beam(input, name=None, **kw):
     beams = input if isinstance(input, (list, tuple)) else [input]
     parents = []
     for b in beams:
-        parents += [b.candidate_scores, b.gold]
+        parents += [b.candidate_scores, b.selected_candidates, b.gold]
 
     def build(ctx, *vals):
         from paddle_tpu import layers as L
         from paddle_tpu.v2.layer import SubSeqVal
 
-        def flat(v, mask_scores=False):
-            # the op contract is (B, n) candidates per expansion; a
-            # nested score tensor compacts its real candidate steps to
-            # the front (so gold indices live in the real-candidate
-            # frame) and masks the padded tail to -inf so it adds no
-            # partition mass to the softmax
+        def flat_scores(v):
+            # op contract is (B, N_i) candidates per expansion in the
+            # PADDED frame (candidate c's parent beam row is c // T, so
+            # nested scores must keep their (B, S, T) grid; padding is
+            # masked to -1e9 so it adds no partition mass)
             if isinstance(v, SubSeqVal):
-                v = _v2._flatten_subseq(v)
+                return _op("mask_padded_subseq_scores",
+                           {"X": [v.var], "Length": [v.lengths],
+                            "SubLength": [v.sub_lengths]})
             if isinstance(v, SeqVal):
                 row = L.reshape(v.var, [0, -1])
-                if mask_scores:
-                    return _op("mask_padded_scores",
-                               {"X": [row], "Length": [v.lengths]})
-                return row
+                return _op("mask_padded_scores",
+                           {"X": [row], "Length": [v.lengths]})
+            return L.reshape(v, [0, -1])
+
+        def flat(v):
+            if isinstance(v, (SeqVal, SubSeqVal)):
+                v = v.var
             return L.reshape(v, [0, -1])
 
         return _op("cross_entropy_over_beam",
-                   {"Scores": [flat(v, mask_scores=True)
-                               for v in vals[0::2]],
-                    "Golds": [flat(v) for v in vals[1::2]]})
+                   {"Scores": [flat_scores(v) for v in vals[0::3]],
+                    "Ids": [flat(v) for v in vals[1::3]],
+                    "Golds": [flat(v) for v in vals[2::3]]})
 
+    # the proto records all three inputs per beam (scores, selected
+    # ids, gold) even though the selected ids only matter at decode
+    # time; size is left unset (reference CrossEntropyOverBeam config)
+    proto_inputs = []
+    for b in beams:
+        proto_inputs += [b.candidate_scores.name,
+                         b.selected_candidates.name, b.gold.name]
     return _simple("cross_entropy_over_beam", parents, build, size=1,
-                   name=name)
+                   name=name, proto_size=None, inputs=proto_inputs)

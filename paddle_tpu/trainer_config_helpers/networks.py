@@ -75,16 +75,29 @@ def simple_lstm(input, size, reverse=False, act=None, name=None,
                 mat_param_attr=None, bias_param_attr=None,
                 lstm_cell_attr=None, **kwargs):
     """fc(4h) -> lstmemory (reference networks.py simple_lstm)."""
-    proj = _l.fc_layer(input=input, size=size * 4, act=LinearActivation(),
-                       param_attr=mat_param_attr, bias_attr=bias_param_attr,
-                       name=name and name + "_proj")
+    proj = _as_mixed(
+        _l.fc_layer(input=input, size=size * 4, act=LinearActivation(),
+                    param_attr=mat_param_attr, bias_attr=bias_param_attr,
+                    name=name and name + "_proj"))
     return _l.lstmemory(input=proj, size=size, reverse=reverse, act=act,
                         name=name)
 
 
+def _as_mixed(lo):
+    """The reference emits these linear transforms as
+    mixed(full_matrix_projection) (networks.py simple_gru/simple_lstm);
+    the math is a bias-free fc — retype the captured entry to match."""
+    entry = getattr(lo, "_cfg_entry", None)
+    if entry is not None:
+        entry["type"] = "mixed"
+        entry["active_type"] = ""
+    return lo
+
+
 def simple_gru(input, size, reverse=False, act=None, name=None, **kwargs):
-    proj = _l.fc_layer(input=input, size=size * 3, act=LinearActivation(),
-                       name=name and name + "_proj")
+    proj = _as_mixed(
+        _l.fc_layer(input=input, size=size * 3, act=LinearActivation(),
+                    name=name and name + "_proj"))
     return _l.grumemory(input=proj, size=size, reverse=reverse, act=act,
                         name=name)
 

@@ -299,7 +299,10 @@ def concat(input: list, name=None, **kwargs):
         return L.concat([v.var if isinstance(v, SeqVal) else v for v in vals],
                         axis=-1 if False else 1)
 
-    return LayerOutput(name or _uname("concat"), list(input), build)
+    sizes = [getattr(i, "size", None) for i in input]
+    total = sum(sizes) if all(s for s in sizes) else None
+    return LayerOutput(name or _uname("concat"), list(input), build,
+                       size=total)
 
 
 # ---------------------------------------------------------------------------

@@ -3,20 +3,22 @@ trainer_config_helpers test suite (reference:
 python/paddle/trainer_config_helpers/tests/configs/*.py, validated there
 against 56 protostr goldens by ProtobufEqualMain.cpp).
 
-This port goes further than the reference test in one direction and is
-honest about the other:
+Three oracles, strongest first:
 
-- every script is *executed* under ``parse_config`` and its captured
-  layer structure (type, name, size per layer + input/output names) is
-  diffed against checked-in goldens (``tests/golden_v1_configs.json``)
-  — the structural analog of the protostr comparison;
-- for the majority of the corpus the built Topology additionally *runs
-  one forward step* with synthesized feeds and must produce finite
-  outputs — something the reference never does;
-- the configs that only parse are listed in ``PARSE_ONLY`` with the
-  concrete reason.
+- ``test_matches_reference_protostr`` — THE authoritative check: the
+  captured layer graph is compared canonically against the
+  *reference's own* checked-in protostr goldens
+  (tests/protostr_oracle.py), so layer types, sizes, activations, and
+  wiring are pinned to the reference spec, not to our own past output;
+- most of the corpus additionally *runs one forward step* with
+  synthesized feeds and must produce finite outputs — something the
+  reference never does; PARSE_ONLY lists the exceptions with reasons;
+- the self-captured JSON goldens (``tests/golden_v1_configs.json``)
+  remain as a regression supplement (they also pin layer *names* and
+  capture order, which the canonical protostr compare ignores).
 
-Regenerate goldens after an intentional DSL change:
+Regenerate the supplement after an intentional DSL change (the
+protostr oracle is never regenerated — it lives in the reference tree):
     PADDLE_TPU_REGEN_GOLDENS=1 python -m pytest tests/test_golden_configs.py -q
 """
 
@@ -263,6 +265,37 @@ def _load_goldens():
     return {}
 
 
+# Configs whose reference golden encodes the recurrent_layer_group
+# machinery (scatter/gather agents, per-step sub-model layers) that this
+# framework deliberately redesigns into fused lax.scan-backed layers
+# (PARITY.md; paddle_tpu/v2/layer.py lstmemory/gru,
+# paddle_tpu/trainer_config_helpers/layers.py recurrent_group).  For
+# these, test_matches_reference_protostr asserts the weaker
+# recurrence-site invariant instead of full canonical equality.
+PROTOSTR_REDESIGNED = {
+    "shared_gru.py":
+        "reference simple_gru = gru_group (recurrent_layer_group with "
+        "scatter/gather agents + gru_step); ours = mixed transform + "
+        "fused gated_recurrent (lax.scan)",
+    "shared_lstm.py":
+        "reference lstmemory_group machinery; ours = mixed transform + "
+        "fused lstmemory (lax.scan)",
+    "test_rnn_group.py":
+        "reference emits one sub-model per recurrent_group with "
+        "agents; ours emits a recurrent_group node wrapping the "
+        "scanned step (tests/test_recurrent_group.py covers numerics)",
+}
+
+# ref group-machinery types that mark one recurrence site
+_REF_RECURRENCE_TYPES = {"recurrent_layer_group"}
+_OUR_RECURRENCE_TYPES = {"gated_recurrent", "lstmemory", "recurrent",
+                         "recurrent_group"}
+
+
+def _protostr_name(fn):
+    return fn[:-len(".py")] + ".protostr"
+
+
 @pytest.mark.parametrize("fn", _configs())
 def test_parse_and_structure(fn):
     conf = _parse(fn)
@@ -284,6 +317,84 @@ def test_parse_and_structure(fn):
     assert got == goldens[fn], (
         f"{fn}: captured structure diverges from the golden; if the "
         f"change is intentional regenerate with PADDLE_TPU_REGEN_GOLDENS=1")
+
+
+@pytest.mark.parametrize("fn", [
+    f for f in _configs()
+    if os.path.exists(os.path.join(
+        os.path.dirname(CONFIG_DIR) + "/configs/protostr",
+        f[:-len(".py")] + ".protostr"))])
+def test_matches_reference_protostr(fn):
+    """THE v1 oracle: the captured layer graph must be
+    wiring-equivalent to the reference's own checked-in protostr golden
+    (reference: .../tests/configs/protostr/*.protostr, compared there
+    by ProtobufEqualMain.cpp).  Canonical comparison is
+    name-independent (tests/protostr_oracle.py): every layer's
+    (type, size, activation, canonical inputs) and the output-layer
+    multiset must match, modulo the short documented mapping tables in
+    protostr_oracle (act/type spellings, aux-input folds, operator
+    splices).  Configs in PROTOSTR_REDESIGNED assert the weaker
+    recurrence-site invariant with the reason stated."""
+    import collections
+
+    import protostr_oracle as po
+
+    golden = po.load_golden(_protostr_name(fn))
+    rl = po.ref_layers(golden)
+    conf = _parse(fn)
+    ours = conf.model_config.layers
+
+    if fn in PROTOSTR_REDESIGNED:
+        # weak invariant: same data layers, same output count, one of
+        # our fused recurrent layers per reference recurrent group
+        ref_data = {(e["name"], e["size"]) for e in rl
+                    if e["type"] == "data"}
+        our_data = {(e["name"], e["size"]) for e in ours
+                    if e["type"] == "data"}
+        assert ref_data == our_data, PROTOSTR_REDESIGNED[fn]
+        n_ref_groups = sum(e["type"] in _REF_RECURRENCE_TYPES for e in rl)
+        n_our_sites = sum(e["type"] in _OUR_RECURRENCE_TYPES for e in ours)
+        assert n_our_sites == n_ref_groups, (
+            f"{fn}: {n_ref_groups} reference recurrent groups vs "
+            f"{n_our_sites} fused recurrence sites — "
+            + PROTOSTR_REDESIGNED[fn])
+        assert len(po.ref_outputs(golden)) == \
+            len(conf.model_config.output_layer_names)
+        return
+
+    it = po.Interner()
+    rcanon = po.canonicalize(rl, it, type_map=po.REF_TYPE_MAP,
+                             drop_inputs=po.REF_DROP_INPUTS)
+    ocanon = po.canonicalize(ours, it, type_map=po.OUR_TYPE_MAP,
+                             drop_inputs=po.OUR_DROP_INPUTS,
+                             splice_types=po.OUR_SPLICE_TYPES)
+    spliced = {e["name"] for e in ours
+               if e["type"] in po.OUR_SPLICE_TYPES}
+    ocanon = {n: c for n, c in ocanon.items() if n not in spliced}
+
+    r_out = collections.Counter(rcanon[n] for n in po.ref_outputs(golden))
+    o_out = collections.Counter(
+        ocanon[n] for n in conf.model_config.output_layer_names)
+    assert r_out == o_out, f"{fn}: output layers diverge from protostr"
+
+    r_all = collections.Counter(rcanon.values())
+    o_all = collections.Counter(ocanon.values())
+    if r_all != o_all:
+        by_ref = {e["name"]: e for e in rl}
+        by_our = {e["name"]: e for e in ours}
+
+        def describe(names, by):
+            return [
+                {k: by[n].get(k) for k in
+                 ("name", "type", "size", "active_type", "inputs")}
+                for n in names]
+
+        extra_ref = [n for n, c in rcanon.items() if c in (r_all - o_all)]
+        extra_our = [n for n, c in ocanon.items() if c in (o_all - r_all)]
+        pytest.fail(
+            f"{fn}: layer graph diverges from the reference protostr.\n"
+            f"reference-only: {describe(extra_ref, by_ref)}\n"
+            f"ours-only: {describe(extra_our, by_our)}")
 
 
 @pytest.mark.parametrize("fn", [f for f in _configs() if f not in PARSE_ONLY])

@@ -160,25 +160,98 @@ def test_lambda_cost_max_sort_size(rng):
     np.testing.assert_allclose(np.asarray(res[1])[0], want, atol=1e-6)
 
 
-def test_cross_entropy_over_beam_op(rng):
+def test_cross_entropy_over_beam_single_step(rng):
+    """One expansion with no beam selection = plain softmax NLL
+    (reference: one softmax over all expanded paths; every candidate
+    is a path)."""
     B = 3
     s1 = rng.randn(B, 4).astype(np.float32)
-    s2 = rng.randn(B, 5).astype(np.float32)
     g1 = np.array([[0], [2], [3]], np.int64)
-    g2 = np.array([[1], [0], [4]], np.int64)
+    t = OpTest()
+    t.op_type = "cross_entropy_over_beam"
+    out, = t.build_and_run({"Scores": [("s1", s1)], "Golds": [("g1", g1)]},
+                           {}, ["Out"])
+
+    e = np.exp(s1 - s1.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    want = -np.log(p[np.arange(B), g1.ravel()])
+    np.testing.assert_allclose(np.asarray(out).ravel(), want, rtol=1e-5)
+
+
+def _ref_beam_nll(step_scores, step_ids, step_golds):
+    """Direct numpy port of the reference objective for ONE sample
+    (CrossEntropyOverBeam.cpp CostForOneSequence): walk expansions
+    until the gold falls off the beam, score every path alive in that
+    expansion as the sum of its selected candidates' scores along its
+    ancestry, one softmax over those paths (+ gold as an extra path if
+    it fell off), return -log p(gold path)."""
+    E = len(step_scores)
+    anc = None
+    gold_sum = 0.0
+    for i in range(E):
+        s, ids, g = step_scores[i], step_ids[i], int(step_golds[i])
+        cur = []
+        for slot in ids:
+            if slot < 0:
+                cur.append(-np.inf)
+            elif anc is None:
+                cur.append(s[slot])
+            else:
+                cpp = len(s) // len(anc)
+                cur.append(anc[slot // cpp] + s[slot])
+        gold_sum += s[g]
+        found = any(slot == g for slot in ids if slot >= 0)
+        if not found or i == E - 1:
+            paths = [c for c in cur if c != -np.inf]
+            if not found:
+                paths.append(gold_sum)
+            m = max(paths)
+            lse = m + np.log(sum(np.exp(p - m) for p in paths))
+            return lse - gold_sum
+        anc = np.array(cur)
+    raise AssertionError("unreachable")
+
+
+def test_cross_entropy_over_beam_two_step_hand_computed(rng):
+    """2-step beam, hand-computable shapes: k=2 beam over 4 candidates,
+    then each kept prefix expands 3 candidates (N_2 = 2*3 = 6).
+    Sample 0 keeps the gold in the beam both steps; sample 1's gold
+    falls off at step 2 (gold-as-extra-path, reference
+    goldAsExtraPath_); sample 2's gold falls off at step 1."""
+    s1 = np.array([[0.1, 0.9, 0.3, 0.2],
+                   [0.5, 0.4, 0.8, 0.1],
+                   [0.2, 0.7, 0.6, 0.3]], np.float32)
+    ids1 = np.array([[1, 2], [2, 0], [1, 2]], np.int64)   # top-2 slots
+    g1 = np.array([[1], [0], [3]], np.int64)               # s2: off-beam
+    s2 = np.array([[0.3, 0.1, 0.7, 0.2, 0.6, 0.4],
+                   [0.9, 0.2, 0.1, 0.5, 0.3, 0.8],
+                   [0.4, 0.4, 0.4, 0.4, 0.4, 0.4]], np.float32)
+    ids2 = np.array([[2, 4], [0, 5], [0, 1]], np.int64)
+    # sample 0: gold prefix (candidate 1) sits in beam slot 0, so its
+    # step-2 expansions are candidates 0..2; gold 2 is selected (found)
+    g2 = np.array([[2], [3], [2]], np.int64)
     t = OpTest()
     t.op_type = "cross_entropy_over_beam"
     out, = t.build_and_run(
-        {"Scores": [("s1", s1), ("s2", s2)], "Golds": [("g1", g1), ("g2", g2)]},
-        {}, ["Out"])
+        {"Scores": [("s1", s1), ("s2", s2)],
+         "Ids": [("i1", ids1), ("i2", ids2)],
+         "Golds": [("g1", g1), ("g2", g2)]}, {}, ["Out"])
 
-    def nll(s, g):
-        e = np.exp(s - s.max(-1, keepdims=True))
-        p = e / e.sum(-1, keepdims=True)
-        return -np.log(p[np.arange(B), g.ravel()])
-
-    np.testing.assert_allclose(np.asarray(out).ravel(),
-                               nll(s1, g1) + nll(s2, g2), rtol=1e-5)
+    want = [_ref_beam_nll([s1[b], s2[b]], [ids1[b], ids2[b]],
+                          [g1[b, 0], g2[b, 0]]) for b in range(3)]
+    np.testing.assert_allclose(np.asarray(out).ravel(), want, rtol=1e-5)
+    # sample 0 sanity, fully by hand: beam keeps candidates {1, 2} of
+    # step 1 (slots 0, 1); step-2 candidates 0..2 descend from slot 0
+    # (prefix candidate 1), 3..5 from slot 1 (prefix candidate 2).
+    # Alive paths: candidate 2 (parent slot 0): s1[1]+s2[2];
+    # candidate 4 (parent slot 1): s1[2]+s2[4].  Gold path (1 -> 2) is
+    # the first -> cost = logsumexp(paths) - (s1[1]+s2[2]).
+    p_a = s1[0, 1] + s2[0, 2]
+    p_b = s1[0, 2] + s2[0, 4]
+    m = max(p_a, p_b)
+    lse = np.log(np.exp(p_a - m) + np.exp(p_b - m)) + m
+    np.testing.assert_allclose(float(np.asarray(out).ravel()[0]),
+                               lse - p_a, rtol=1e-5)
 
 
 def test_lambda_cost_training_improves_ndcg(rng):
