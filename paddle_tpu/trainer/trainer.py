@@ -121,6 +121,83 @@ class Trainer:
     def test(self):
         return self._sgd.test(self._reader_from_sources(train=False))
 
+    def check_gradient(self, epsilon: float = 1e-3, max_elems: int = 8,
+                       rtol: float = 1e-2, atol: float = 1e-2):
+        """Central-difference gradient check of the config's parameters
+        through the trainer entry (reference: Trainer.cpp:430
+        Trainer::checkGradient — perturb parameters, compare the
+        analytic dCost/dW against (cost(w+eps) - cost(w-eps)) / 2eps).
+
+        Uses one batch from the train source; checks up to
+        ``max_elems`` elements per parameter (the reference samples
+        too).  Returns {param_name: max_abs_diff}; raises AssertionError
+        on mismatch."""
+        from paddle_tpu import executor as executor_mod
+        from paddle_tpu.backward import append_backward
+
+        topo = self._sgd.topology
+        batch = next(iter(self._reader_from_sources(train=True)()))
+        from paddle_tpu.v2.trainer import V2DataFeeder
+
+        feed = V2DataFeeder(topo.feed_types).feed(batch)
+
+        # grad program: a clone of the forward with backward appended
+        # (the SGD program already fused the update; gradients must be
+        # read before any update, so build a separate program)
+        prog = topo.main_program.clone(for_test=True)
+        with_scope = executor_mod.scope_guard(self.parameters.scope)
+        import paddle_tpu.framework as framework
+
+        with framework.program_guard(prog):
+            loss = prog.global_block().var(topo.cost_var.name)
+            pairs = append_backward(loss)
+        from paddle_tpu.executor import Executor
+        from paddle_tpu.framework import CPUPlace
+
+        exe = Executor(CPUPlace())
+        grad_names = [g.name for _, g in pairs]
+        with with_scope:
+            vals = exe.run(prog, feed=feed,
+                           fetch_list=[topo.cost_var.name] + grad_names)
+        analytic = {p.name: np.asarray(g)
+                    for (p, _), g in zip(pairs, vals[1:])}
+
+        def cost_with(name, arr):
+            self.parameters.set(name, arr)
+            with executor_mod.scope_guard(self.parameters.scope):
+                (c,) = exe.run(prog, feed=feed,
+                               fetch_list=[topo.cost_var.name])
+            return float(np.asarray(c).reshape(-1)[0])
+
+        report = {}
+        rng = np.random.RandomState(0)
+        for name in self.parameters.keys():
+            if name not in analytic:
+                continue
+            base = np.array(self.parameters.get(name))
+            flat = base.reshape(-1)
+            idx = rng.choice(flat.size, size=min(max_elems, flat.size),
+                             replace=False)
+            worst = 0.0
+            for i in idx:
+                pert = flat.copy()
+                pert[i] += epsilon
+                up = cost_with(name, pert.reshape(base.shape))
+                pert[i] -= 2 * epsilon
+                down = cost_with(name, pert.reshape(base.shape))
+                num = (up - down) / (2 * epsilon)
+                ana = float(analytic[name].reshape(-1)[i])
+                diff = abs(num - ana)
+                worst = max(worst, diff)
+                if diff > atol + rtol * abs(num):
+                    self.parameters.set(name, base)
+                    raise AssertionError(
+                        f"checkgrad: {name}[{i}] analytic {ana:.6f} vs "
+                        f"numeric {num:.6f} (eps={epsilon})")
+            self.parameters.set(name, base)
+            report[name] = worst
+        return report
+
     # -- model export (the `paddle merge_model` surface) --------------------
 
     def load_parameters(self, model_dir: str):
@@ -179,8 +256,16 @@ def main(argv=None):
 
     p = argparse.ArgumentParser(prog="paddle_trainer")
     p.add_argument("--config", required=True)
+    p.add_argument("--job", default="train",
+                   choices=["train", "test", "checkgrad"],
+                   help="train | test (evaluate over the test source) | "
+                        "checkgrad (central-difference parameter check); "
+                        "reference Trainer.cpp:265-533")
     p.add_argument("--num_passes", type=int, default=1)
     p.add_argument("--save_dir", default=None)
+    p.add_argument("--init_model_path", default=None,
+                   help="pass dir / save_dir / params.tar to load before "
+                        "--job=test (reference ParamUtil::loadParameters)")
     p.add_argument("--config_args", default="")
     p.add_argument("--log_period", type=int, default=100)
     p.add_argument("--use_gpu", default=None, help="ignored (TPU build)")
@@ -194,6 +279,30 @@ def main(argv=None):
 
         v2pkg.init(trainer_count=a.trainer_count)
     t0 = time.time()
+    if a.job == "test":
+        conf = parse_config(a.config, a.config_args)
+        t = Trainer(conf)
+        if a.init_model_path:
+            t.load_parameters(a.init_model_path)
+        result = t.test()
+        dt = time.time() - t0
+        print(f"Test done in {dt:.1f}s, cost "
+              f"{result.cost if result.cost is not None else float('nan'):.6f}",
+              flush=True)
+        return 0
+    if a.job == "checkgrad":
+        conf = parse_config(a.config, a.config_args)
+        t = Trainer(conf)
+        if a.init_model_path:
+            t.load_parameters(a.init_model_path)
+        report = t.check_gradient()
+        dt = time.time() - t0
+        for name, diff in sorted(report.items()):
+            print(f"checkgrad {name}: max |analytic - numeric| = "
+                  f"{diff:.6g}", flush=True)
+        print(f"Gradient check PASSED ({len(report)} parameters, "
+              f"{dt:.1f}s)", flush=True)
+        return 0
     _, costs = train_from_config(a.config, num_passes=a.num_passes,
                                  save_dir=a.save_dir,
                                  config_args=a.config_args,

@@ -1,0 +1,186 @@
+"""Config-equivalence tests (reference:
+paddle/gserver/tests/test_NetworkCompare.cpp and
+paddle/trainer/tests/test_CompareTwoNets.cpp): two different config
+formulations of the same computation, with parameters forced equal,
+must produce identical outputs.
+
+Each pair builds both formulations in fresh programs, pairs up their
+created parameters by creation order (asserting matching shapes), sets
+both from the same fixed-seed values, and compares `paddle.infer`
+outputs to fp32 tolerance."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.v2 as paddle
+
+
+def _fresh():
+    import paddle_tpu.executor as em
+    import paddle_tpu.framework as framework
+
+    framework.reset_default_programs()
+    em._global_scope = em.Scope()
+    em._scope_stack = [em._global_scope]
+    paddle.init()
+
+
+def _infer_with_shared_params(build_a, build_b, rows, rtol=1e-5):
+    """Build both nets, equalize parameters pairwise (by creation
+    order), return (out_a, out_b)."""
+    outs = []
+    all_params = []
+    for build in (build_a, build_b):
+        _fresh()
+        out_layer = build()
+        params = paddle.parameters.create(out_layer)
+        all_params.append((out_layer, params))
+    names_a = all_params[0][1].keys()
+    names_b = all_params[1][1].keys()
+    assert len(names_a) == len(names_b), (names_a, names_b)
+    rng = np.random.RandomState(7)
+    for na, nb in zip(names_a, names_b):
+        wa = all_params[0][1].get(na)
+        wb = all_params[1][1].get(nb)
+        assert wa.shape == wb.shape, (na, wa.shape, nb, wb.shape)
+        w = rng.uniform(-0.5, 0.5, wa.shape).astype(np.float32)
+        all_params[0][1].set(na, w)
+        all_params[1][1].set(nb, w)
+    for out_layer, params in all_params:
+        outs.append(np.asarray(paddle.infer(output_layer=out_layer,
+                                            parameters=params, input=rows)))
+    np.testing.assert_allclose(outs[0], outs[1], rtol=rtol, atol=1e-6)
+    return outs
+
+
+def _x(dim=6, B=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(dim).astype(np.float32),) for _ in range(B)]
+
+
+def test_mixed_full_matrix_projection_equals_fc():
+    """mixed(full_matrix_projection) == bias-free linear fc_layer
+    (reference test_NetworkCompare img_conv-style pairings; the two
+    take different build paths — projection emission vs fc mul)."""
+    from paddle_tpu.trainer_config_helpers import layers as v1
+
+    def via_mixed():
+        x = v1.data_layer(name="x", size=6)
+        with v1.mixed_layer(size=3) as m:
+            m += v1.full_matrix_projection(input=x)
+        return m._lo
+
+    def via_fc():
+        from paddle_tpu.trainer_config_helpers.activations import \
+            LinearActivation
+
+        x = v1.data_layer(name="x", size=6)
+        return v1.fc_layer(input=x, size=3, act=LinearActivation(),
+                           bias_attr=False)
+
+    _infer_with_shared_params(via_mixed, via_fc, _x())
+
+
+def test_addto_equals_identity_projection_mixed():
+    """addto_layer([a, b]) == mixed(identity(a) + identity(b)) — the
+    two sum paths (elementwise_add chain vs projection accumulation)."""
+    from paddle_tpu.trainer_config_helpers import layers as v1
+
+    rng = np.random.RandomState(1)
+    rows = [(rng.randn(5).astype(np.float32),
+             rng.randn(5).astype(np.float32)) for _ in range(3)]
+
+    def via_addto():
+        a = v1.data_layer(name="a", size=5)
+        b = v1.data_layer(name="b", size=5)
+        return v1.addto_layer(input=[a, b])
+
+    def via_mixed():
+        a = v1.data_layer(name="a", size=5)
+        b = v1.data_layer(name="b", size=5)
+        with v1.mixed_layer(size=5) as m:
+            m += v1.identity_projection(input=a)
+            m += v1.identity_projection(input=b)
+        return m._lo
+
+    _infer_with_shared_params(via_addto, via_mixed, rows)
+
+
+def test_repeat_layer_equals_self_concat():
+    """repeat_layer(x, 2) == concat_layer([x, x]) (featmap_expand
+    tiling vs the concat path)."""
+    from paddle_tpu.trainer_config_helpers import layers as v1
+
+    def via_repeat():
+        x = v1.data_layer(name="x", size=4)
+        return v1.repeat_layer(input=x, num_repeats=2)
+
+    def via_concat():
+        x = v1.data_layer(name="x", size=4)
+        return v1.concat_layer(input=[x, x])
+
+    _infer_with_shared_params(via_repeat, via_concat, _x(dim=4, B=3))
+
+
+def test_simple_lstm_equals_explicit_composition():
+    """networks.simple_lstm == explicit fc(4h, linear) -> lstmemory
+    (reference test_CompareTwoNets: helper-macro vs hand-written
+    composition must match bit-for-bit given equal parameters)."""
+    from paddle_tpu.trainer_config_helpers import layers as v1
+    from paddle_tpu.trainer_config_helpers.activations import \
+        LinearActivation
+    from paddle_tpu.trainer_config_helpers.networks import simple_lstm
+    from paddle_tpu.v2.data_type import dense_vector_sequence
+
+    rng = np.random.RandomState(2)
+    rows = [(rng.randn(int(rng.randint(2, 6)), 6).astype(np.float32),)
+            for _ in range(3)]
+
+    def seq_data():
+        x = v1.data_layer(name="x", size=6)
+        x.input_type = dense_vector_sequence(6)
+        return x
+
+    def via_helper():
+        x = seq_data()
+        lstm = simple_lstm(input=x, size=4)
+        return v1.last_seq(input=lstm)
+
+    def via_explicit():
+        x = seq_data()
+        proj = v1.fc_layer(input=x, size=16, act=LinearActivation())
+        lstm = v1.lstmemory(input=proj, size=4)
+        return v1.last_seq(input=lstm)
+
+    _infer_with_shared_params(via_helper, via_explicit, rows)
+
+
+def test_gated_unit_equals_manual_gate():
+    """gated_unit_layer == fc(act) * fc(sigmoid) composed by hand."""
+    from paddle_tpu.trainer_config_helpers import layers as v1
+    from paddle_tpu.trainer_config_helpers.activations import (
+        SigmoidActivation, TanhActivation)
+    from paddle_tpu.trainer_config_helpers.layers_extra import \
+        gated_unit_layer
+
+    def via_gated():
+        x = v1.data_layer(name="x", size=6)
+        return gated_unit_layer(input=x, size=3, act=TanhActivation())
+
+    def via_manual():
+        x = v1.data_layer(name="x", size=6)
+        proj = v1.fc_layer(input=x, size=3, act=TanhActivation())
+        gate = v1.fc_layer(input=x, size=3, act=SigmoidActivation())
+
+        def build(ctx, p, g):
+            from paddle_tpu import layers as L
+            from paddle_tpu.trainer_config_helpers.layers_extra import \
+                _unwrap
+
+            return L.elementwise_mul(_unwrap(p), _unwrap(g))
+
+        from paddle_tpu.v2.layer import LayerOutput
+
+        return LayerOutput("manual_gate", [proj, gate], build, size=3)
+
+    _infer_with_shared_params(via_gated, via_manual, _x())
