@@ -1,0 +1,106 @@
+"""Static FLOPs accounting over a built Program (matmul/conv terms).
+
+Guard rail demanded by the round-4 GoogLeNet incident: a missing stem
+stride made the model do 4x the work for three rounds of benchmarking
+without anything noticing — throughput numbers alone can't tell
+"slower" from "doing more work".  ``program_flops`` counts the
+forward matmul/conv FLOPs straight from the program's static shapes,
+and ``assert_model_flops`` pins each bench model to its published
+per-image cost so a work regression fails the bench run loudly.
+"""
+
+from __future__ import annotations
+
+
+def _prod(xs):
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out
+
+
+def program_flops(prog, batch_hint: int = 1) -> float:
+    """Forward matmul/conv FLOPs of a program from static var shapes
+    (2*M*N*K per matmul; elementwise/norm traffic excluded — those are
+    bandwidth, not MXU work).  Backward is not counted: callers compare
+    forward-only architecture cost.  ``batch_hint`` substitutes for
+    symbolic (-1/None) leading batch dims."""
+    block = prog.global_block()
+    total = 0.0
+
+    def dims(shape, hint):
+        return [int(d) if d and d > 0 else hint for d in shape]
+
+    for op in block.ops:
+        t = op.type
+        try:
+            if t in ("conv2d", "conv2d_cudnn", "conv2d_transpose"):
+                w = block.var(op.input("Filter")[0])
+                out = block.var(op.output("Output")[0])
+                ow = dims(out.shape, batch_hint)
+                # out (N, K, OH, OW); filter (K, C/g, kh, kw)
+                n = ow[0] if len(ow) == 4 else 1
+                oh_ow = _prod(ow[-2:])
+                k, cpg, kh, kw = [int(d) for d in w.shape]
+                total += 2.0 * n * k * cpg * kh * kw * oh_ow
+            elif t == "conv3d":
+                w = block.var(op.input("Filter")[0])
+                out = block.var(op.output("Output")[0])
+                ow = dims(out.shape, batch_hint)
+                n = ow[0] if len(ow) == 5 else 1
+                od_oh_ow = _prod(ow[-3:])
+                k, cpg, kd, kh, kw = [int(d) for d in w.shape]
+                total += 2.0 * n * k * cpg * kd * kh * kw * od_oh_ow
+            elif t in ("mul", "matmul"):
+                x = block.var(op.input("X")[0])
+                y = block.var(op.input("Y")[0])
+                xs = dims(x.shape, batch_hint)
+                ys = [int(d) for d in y.shape]  # weights: static
+                if t == "mul":
+                    ncol = int(op.attr("x_num_col_dims") or 1)
+                    m = _prod(xs[:ncol]) or 1
+                    kdim = _prod(xs[ncol:])
+                    ndim = _prod(d for d in ys[1:] if d > 0)
+                    total += 2.0 * m * kdim * ndim
+                else:
+                    # batched (..., M, K) x (..., K, N)
+                    b = _prod(xs[:-2]) or 1
+                    m, kdim = xs[-2], xs[-1]
+                    ndim = ys[-1] if ys[-1] > 0 else batch_hint
+                    total += 2.0 * b * m * kdim * ndim
+        except Exception:
+            # unknown/dynamic shapes: skip the op rather than guess
+            continue
+    return total
+
+
+# forward cost per image at 224x224 (3x32x32 for smallnet) in true
+# FLOPs = 2x the papers' published multiply-accumulate counts (He et
+# al. count a MAC as one "FLOP"; the MFU convention here and in
+# bench.py is 2 FLOPs/MAC).  Tolerance is wide enough for head
+# variants but far tighter than the 4x-class regressions this guards.
+EXPECTED_FWD_GFLOPS_PER_IMG = {
+    "resnet50": 7.7,     # He et al. 2015: 3.8-4.1 GMAC incl. fc
+    "googlenet": 3.2,    # Szegedy et al. 2014: ~1.5 GMAC + aux heads
+    "alexnet": 1.43,     # single-tower variant, ~0.7 GMAC
+    "vgg16": 31.0,       # 15.5 GMAC
+    "smallnet": 0.082,   # resnet-20 cifar10, 41 MMAC
+}
+
+
+def assert_model_flops(model_name, prog, batch, rtol=0.35):
+    """Fail loudly when the built program's conv/matmul work diverges
+    from the architecture's published per-image FLOPs."""
+    want = EXPECTED_FWD_GFLOPS_PER_IMG.get(model_name)
+    if want is None:
+        return None
+    got = program_flops(prog, batch_hint=batch) / batch / 1e9
+    if not (want * (1 - rtol) <= got <= want * (1 + rtol)):
+        raise AssertionError(
+            f"{model_name}: program does {got:.2f} GFLOP/img forward vs "
+            f"the architecture's ~{want} GFLOP/img (tolerance "
+            f"{rtol:.0%}) — the model graph is doing the wrong amount "
+            f"of WORK (cf. the round-4 GoogLeNet missing-stem-stride "
+            f"4x bug); fix the graph before trusting any throughput "
+            f"number")
+    return got

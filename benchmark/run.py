@@ -105,6 +105,14 @@ def bench_model(model_name, batch=None, steps=None, warmup=3):
     steps = steps or int(os.environ.get("BENCH_STEPS", 10))
     rng = np.random.RandomState(0)
     exe, prog, loss, feed = _train_step_fn(model_name, batch)
+    # work guard: a graph doing the wrong amount of FLOPs (round-4
+    # GoogLeNet stem-stride 4x bug) must fail here, not ship a number
+    from flops import assert_model_flops
+
+    if os.environ.get("BENCH_SMOKE", "0") != "1":
+        fwd_gflop = assert_model_flops(model_name, prog, batch)
+    else:
+        fwd_gflop = None
     dev_feed = {k: jnp.asarray(v) for k, v in feed(rng).items()}
     for _ in range(warmup):
         (l,) = exe.run(prog, feed=dev_feed, fetch_list=[loss],
@@ -123,28 +131,233 @@ def bench_model(model_name, batch=None, steps=None, warmup=3):
     return {"model": model_name, "batch": batch,
             "img_per_sec": round(batch / dt, 2),
             "ms_per_batch": round(dt * 1e3, 2),
+            "fwd_gflop_per_img": (round(fwd_gflop, 3)
+                                  if fwd_gflop is not None else None),
             "baseline_ms_per_batch": base_ms,
             "baseline_batch": base_batch,
             "vs_baseline": round(vs, 2),
             "baseline_source": base_src}
 
 
+def _device_peak():
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    nominal = {"TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v4": 275e12,
+               "TPU v6": 918e12}
+    return next((v for k, v in nominal.items() if kind.startswith(k)), None)
+
+
+def bench_seq2seq(batch=None, steps=None, warmup=3):
+    """Attention NMT training throughput (BASELINE.json acceptance
+    config #3 at bench scale): GRU encoder + recurrent_group decoder
+    with simple_attention, the demos/seq2seq architecture scaled to
+    VOCAB=30k, EMB=HID=512, S=32.  Reports tokens/s + MFU; the
+    reference publishes no NMT number (benchmark/paddle/rnn covers the
+    LSTM classifier only), so vs_baseline is null."""
+    import jax.numpy as jnp
+
+    from paddle_tpu import amp
+
+    if os.environ.get("BENCH_AMP", "1") == "1":
+        amp.enable()
+    VOCAB, EMB, HID, S = 30000, 512, 512, 32
+    B = batch or int(os.environ.get("BENCH_BATCH", 0)) or 64
+    steps = steps or int(os.environ.get("BENCH_STEPS", 10))
+
+    import paddle_tpu as fluid
+    import paddle_tpu.executor as executor_mod
+    from paddle_tpu.trainer.config_parser import parse_config
+    from paddle_tpu.trainer.trainer import Trainer
+
+    fluid.framework.reset_default_programs()
+
+    def config():
+        from paddle_tpu.trainer_config_helpers import (
+            AdamOptimizer, LinearActivation, ParamAttr, SoftmaxActivation,
+            StaticInput, classification_cost, data_layer,
+            embedding_layer, fc_layer, grumemory, memory, outputs,
+            recurrent_group, settings)
+        from paddle_tpu.trainer_config_helpers.networks import \
+            simple_attention
+
+        settings(batch_size=B, learning_rate=1e-3,
+                 learning_method=AdamOptimizer())
+        src = data_layer(name="src", size=VOCAB)
+        src_emb = embedding_layer(input=src, size=EMB,
+                                  param_attr=ParamAttr(name="src_emb"))
+        enc_proj = fc_layer(input=src_emb, size=3 * HID,
+                            act=LinearActivation(), bias_attr=False)
+        enc = grumemory(input=enc_proj, size=HID, name="enc_seq")
+        trg_in = data_layer(name="trg_in", size=VOCAB)
+        trg_out = data_layer(name="trg_out", size=VOCAB)
+        trg_emb = embedding_layer(input=trg_in, size=EMB,
+                                  param_attr=ParamAttr(name="trg_emb"))
+
+        def step(word, enc_states):
+            from paddle_tpu.trainer_config_helpers.layers_extra import \
+                gru_step_layer
+
+            dec_mem = memory(name="dec_state", size=HID)
+            ctx = simple_attention(encoded_sequence=enc_states,
+                                   encoded_proj=enc_states,
+                                   decoder_state=dec_mem)
+            inp = fc_layer(input=[word, ctx], size=3 * HID,
+                           act=LinearActivation(), bias_attr=False)
+            dec = gru_step_layer(input=inp, output_mem=dec_mem, size=HID,
+                                 name="dec_state")
+            return fc_layer(input=dec, size=VOCAB,
+                            act=SoftmaxActivation())
+
+        probs = recurrent_group(step=step,
+                                input=[trg_emb,
+                                       StaticInput(enc, is_seq=True,
+                                                   size=HID)])
+        outputs(classification_cost(input=probs, label=trg_out))
+
+    conf = parse_config(config)
+    from paddle_tpu.v2.data_type import integer_value_sequence
+
+    for name in ("src", "trg_in", "trg_out"):
+        conf.data_layers[name].input_type = integer_value_sequence(VOCAB)
+    t = Trainer(conf)
+    topo = t._sgd.topology
+    prog = topo.main_program
+    rng = np.random.RandomState(0)
+    lens = np.full((B,), S, np.int32)
+    feed = {
+        "src": jnp.asarray(rng.randint(2, VOCAB, (B, S)).astype(np.int64)),
+        "src@len": jnp.asarray(lens),
+        "trg_in": jnp.asarray(rng.randint(2, VOCAB, (B, S)).astype(np.int64)),
+        "trg_in@len": jnp.asarray(lens),
+        "trg_out": jnp.asarray(
+            rng.randint(2, VOCAB, (B, S)).astype(np.int64)),
+        "trg_out@len": jnp.asarray(lens),
+    }
+    from paddle_tpu.executor import Executor
+    from paddle_tpu.framework import TPUPlace
+
+    exe = Executor(TPUPlace())
+    with executor_mod.scope_guard(t.parameters.scope):
+        for _ in range(warmup):
+            (l,) = exe.run(prog, feed=feed,
+                           fetch_list=[topo.cost_var.name],
+                           return_numpy=False)
+        float(np.asarray(l).ravel()[0])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            (l,) = exe.run(prog, feed=feed,
+                           fetch_list=[topo.cost_var.name],
+                           return_numpy=False)
+        float(np.asarray(l).ravel()[0])
+    dt = (time.perf_counter() - t0) / steps
+    tokens = B * S
+    # model FLOPs per step (matmul terms only, x3 for fwd+bwd):
+    # encoder: emb->3H proj + GRU recurrent 3H*H; decoder per target
+    # token: attention (2 H*H projections + 2*S H-dots + S scores),
+    # input proj (EMB+H)->3H, GRU 3H*H, output fc H*VOCAB (dominant)
+    per_tok = (EMB * 3 * HID + 3 * HID * HID            # encoder
+               + 2 * HID * HID + 2 * S * HID            # attention
+               + (EMB + HID) * 3 * HID + 3 * HID * HID  # decoder gru
+               + HID * VOCAB)                           # softmax fc
+    flops = 3 * 2 * per_tok * tokens
+    peak = _device_peak()
+    return {"model": "seq2seq_nmt_attention", "batch": B, "seq_len": S,
+            "vocab": VOCAB, "emb": EMB, "hidden": HID,
+            "tokens_per_sec": round(tokens / dt, 1),
+            "ms_per_batch": round(dt * 1e3, 2),
+            "model_tflop_per_step": round(flops / 1e12, 4),
+            "mfu_vs_nominal": (round(flops / dt / peak, 4)
+                               if peak else None),
+            "vs_baseline": None,
+            "baseline_source": "no published reference NMT number "
+                               "(benchmark/paddle/rnn is the LSTM "
+                               "classifier); acceptance config tracked "
+                               "for trend"}
+
+
+def bench_wide_deep(batch=None, steps=None, warmup=3):
+    """Wide&Deep CTR with the sparse lookup_table path on
+    (BASELINE.json acceptance config #4 at bench scale): 1e5-row wide
+    table, 26 deep fields.  Reports examples/s; the reference publishes
+    no CTR throughput number, so vs_baseline is null."""
+    import jax.numpy as jnp
+
+    from paddle_tpu import amp
+
+    if os.environ.get("BENCH_AMP", "1") == "1":
+        amp.enable()
+    Wv, Dv, F, W = 100_000, 10_000, 26, 26
+    B = batch or int(os.environ.get("BENCH_BATCH", 0)) or 1024
+    steps = steps or int(os.environ.get("BENCH_STEPS", 10))
+
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+
+    fluid.framework.reset_default_programs()
+    wide = fluid.layers.data(name="wide", shape=[W, 1], dtype="int64")
+    deep = fluid.layers.data(name="deep", shape=[F, 1], dtype="int64")
+    label = fluid.layers.data(name="label", shape=[1], dtype="float32")
+    prob = models.wide_deep(wide, deep, wide_vocab=Wv, deep_vocab=Dv,
+                            num_fields=F, emb_dim=16, hidden=(256, 128),
+                            is_sparse=True)
+    loss = fluid.layers.mean(fluid.layers.log_loss(prob, label))
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {"wide": jnp.asarray(
+                rng.randint(0, Wv, (B, W, 1)).astype(np.int64)),
+            "deep": jnp.asarray(
+                rng.randint(0, Dv, (B, F, 1)).astype(np.int64)),
+            "label": jnp.asarray(
+                (rng.rand(B, 1) < 0.3).astype(np.float32))}
+    for _ in range(warmup):
+        (l,) = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
+    float(np.asarray(l).ravel()[0])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        (l,) = exe.run(feed=feed, fetch_list=[loss], return_numpy=False)
+    float(np.asarray(l).ravel()[0])
+    dt = (time.perf_counter() - t0) / steps
+    return {"model": "wide_deep_ctr_sparse", "batch": B,
+            "wide_vocab": Wv, "deep_vocab": Dv, "fields": F,
+            "examples_per_sec": round(B / dt, 1),
+            "ms_per_batch": round(dt * 1e3, 3),
+            "vs_baseline": None,
+            "baseline_source": "no published reference CTR throughput; "
+                               "sparse-path acceptance config tracked "
+                               "for trend"}
+
+
+EXTRA_BENCHES = {"seq2seq": bench_seq2seq, "wide_deep": bench_wide_deep}
+
+
 def main(argv=None):
-    names = (argv or sys.argv[1:]) or list(BASELINES)
+    names = (argv or sys.argv[1:]) or (list(BASELINES)
+                                       + list(EXTRA_BENCHES))
     rows = []
     for n in names:
         try:
-            r = bench_model(n)
+            r = EXTRA_BENCHES[n]() if n in EXTRA_BENCHES else bench_model(n)
         except Exception as e:  # keep sweeping; record the failure
             r = {"model": n, "error": f"{type(e).__name__}: {e}"}
             print(json.dumps(r), flush=True)
             rows.append(r)
             continue
         rows.append(r)
-        print(f"{r['model']:<10} bs={r['batch']:<4} "
-              f"{r['img_per_sec']:>10.2f} img/s  "
-              f"{r['ms_per_batch']:>8.2f} ms/batch  "
-              f"{r['vs_baseline']:>7.2f}x baseline", flush=True)
+        if "img_per_sec" in r:
+            print(f"{r['model']:<10} bs={r['batch']:<4} "
+                  f"{r['img_per_sec']:>10.2f} img/s  "
+                  f"{r['ms_per_batch']:>8.2f} ms/batch  "
+                  f"{r['vs_baseline']:>7.2f}x baseline", flush=True)
+        else:
+            rate = r.get("tokens_per_sec") or r.get("examples_per_sec")
+            unit = "tok/s" if "tokens_per_sec" in r else "ex/s"
+            mfu = r.get("mfu_vs_nominal")
+            print(f"{r['model']:<24} bs={r['batch']:<5} "
+                  f"{rate:>10.1f} {unit}  {r['ms_per_batch']:>8.2f} ms/batch"
+                  + (f"  MFU {mfu:.1%}" if mfu else ""), flush=True)
         print(json.dumps(r), flush=True)
     return rows
 
