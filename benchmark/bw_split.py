@@ -1,0 +1,102 @@
+"""Bandwidth attribution over an xprof trace: for every device XLA op,
+estimate HBM bytes moved from the tensor shapes in its HLO result type
+and report effective GB/s, so "is this step bandwidth-bound?" has a
+number instead of a vibe.
+
+Usage: python benchmark/bw_split.py /tmp/rn50_trace [n_steps]
+
+Byte model per op (conservative):
+  - the op writes its result tensors once, and reads at least the
+    same volume of operands (factor 2 total) — multi-operand fusions
+    read MORE, so the derived GB/s is a LOWER bound on achieved
+    bandwidth;
+  - convolution/dot ops are flagged [MXU] and excluded from the
+    bandwidth bound (their time is compute).
+"""
+
+import re
+import sys
+
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from xprof import find_trace, load_xspace  # noqa: E402
+
+_DTYPE_BYTES = {"bf16": 2, "f16": 2, "f32": 4, "s32": 4, "u32": 4,
+                "s8": 1, "u8": 1, "pred": 1, "s64": 8}
+_OPCODE = re.compile(r"\s+[a-z][a-z\-.0-9]*\(")
+
+
+def result_bytes(name):
+    """Tensor bytes of the op's RESULT type(s) only (the text right of
+    " = " up to the opcode word)."""
+    if " = " not in name:
+        return 0
+    rhs = name.split(" = ", 1)[1]
+    head = _OPCODE.split(rhs)[0]
+    total = 0
+    for m in re.finditer(
+            r"(bf16|f16|f32|s32|u32|s8|u8|pred|s64)\[([\d,]*)\]", head):
+        dt, dims = m.group(1), m.group(2)
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dt]
+    return total
+
+
+def main():
+    path = find_trace(sys.argv[1])
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+    xs = load_xspace(path)
+    for plane in xs.planes:
+        if not plane.name.startswith("/device:"):
+            continue
+        meta = plane.event_metadata
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            agg = {}
+            for ev in line.events:
+                name = meta[ev.metadata_id].name
+                t, n = agg.get(name, (0.0, 0))
+                agg[name] = (t + ev.duration_ps / 1e12, n + 1)
+            rows = sorted(((t, n, name) for name, (t, n) in agg.items()),
+                          reverse=True)
+            if not rows:
+                print(f"== {plane.name}: no XLA op events")
+                continue
+            total = sum(t for t, _, _ in rows)
+            print(f"== {plane.name}: busy {total/steps*1e3:.2f} ms/step")
+            print(f"{'ms/step':>8} {'share':>6} {'GB/step':>8} "
+                  f"{'>=GB/s':>7}  op")
+            bw_time = mxu_time = bw_bytes = 0.0
+            for t, n, name in rows:
+                per = t / steps
+                rb = result_bytes(name) * n / steps
+                is_mxu = ("convolution" in name.split(" = ")[0]
+                          or re.search(r"%(dot|conv)", name.split(" = ")[0]))
+                traffic = rb * 2
+                if is_mxu:
+                    mxu_time += per
+                else:
+                    bw_time += per
+                    bw_bytes += traffic
+                if per * steps >= rows[min(29, len(rows) - 1)][0]:
+                    gbs = traffic / per / 1e9 if per else 0
+                    label = name.split(" = ")[0]
+                    print(f"{per*1e3:8.3f} {t/total:6.1%} {traffic/1e9:8.3f} "
+                          f"{gbs:7.0f}  {label[:55]}"
+                          f"{' [MXU]' if is_mxu else ''}")
+            print(f"\nMXU (conv/dot standalone) time: {mxu_time*1e3:.1f} "
+                  f"ms/step")
+            if bw_time:
+                print(f"non-MXU time: {bw_time*1e3:.1f} ms/step moving "
+                      f">= {bw_bytes/1e9:.1f} GB/step "
+                      f"=> >= {bw_bytes/bw_time/1e9:.0f} GB/s average")
+
+
+if __name__ == "__main__":
+    main()

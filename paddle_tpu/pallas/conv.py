@@ -86,13 +86,30 @@ def fits(n, h, w, c, o, kh, kw, stride, padding) -> bool:
             and _dw_batch_block(n, w, wp, c, o, kh, kw) is not None)
 
 
-def _fwd_kernel(x_ref, w_ref, o_ref, acc_ref, *scratch, kh_steps,
-                kw_steps, ow, fold_kw):
+def _fwd_kernel(x_ref, w_ref, o_ref, *rest, kh_steps, kw_steps, ow,
+                fold_kw, with_stats=False):
+    """Forward conv; with ``with_stats`` the per-channel BN sum /
+    sum-of-squares accumulate in the flush epilogue while the f32
+    output block is still in VMEM (the round-5 epilogue-fusion
+    experiment) — stats outputs are revisited every step, so the grid
+    must then be fully sequential."""
+    if with_stats:
+        sum_ref, sq_ref, acc_ref, *scratch = rest
+    else:
+        sum_ref = sq_ref = None
+        acc_ref, *scratch = rest
     kh = pl.program_id(2)
 
     @pl.when(kh == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    if with_stats:
+        @pl.when((pl.program_id(0) == 0) & (pl.program_id(1) == 0)
+                 & (kh == 0))
+        def _init_stats():
+            sum_ref[:] = jnp.zeros_like(sum_ref)
+            sq_ref[:] = jnp.zeros_like(sq_ref)
 
     row = x_ref[:, 0]                       # (bb, Wp, C)
     b = row.shape[0]
@@ -117,13 +134,17 @@ def _fwd_kernel(x_ref, w_ref, o_ref, acc_ref, *scratch, kh_steps,
 
     @pl.when(kh == kh_steps - 1)
     def _flush():
-        o_ref[:, 0] = acc_ref[:].reshape(b, ow, -1).astype(o_ref.dtype)
+        acc = acc_ref[:]
+        o_ref[:, 0] = acc.reshape(b, ow, -1).astype(o_ref.dtype)
+        if with_stats:
+            sum_ref[:] += jnp.sum(acc, axis=0, keepdims=True)
+            sq_ref[:] += jnp.sum(acc * acc, axis=0, keepdims=True)
 
 
 @functools.partial(jax.jit, static_argnames=("padding", "interpret",
-                                             "fold_kw"))
+                                             "fold_kw", "with_stats"))
 def _conv_fwd_impl(x, w, padding: int, interpret: bool = False,
-                   fold_kw: bool = False):
+                   fold_kw: bool = False, with_stats: bool = False):
     n, h, wd, c = x.shape
     kh, kw, c2, o = w.shape
     assert c == c2, (x.shape, w.shape)
@@ -137,20 +158,31 @@ def _conv_fwd_impl(x, w, padding: int, interpret: bool = False,
     scratch = [pltpu.VMEM((bb * wd, o), jnp.float32)]
     if fold_kw:
         scratch.append(pltpu.VMEM((bb, wd, kw * c), x.dtype))
+    out_specs = pl.BlockSpec((bb, 1, wd, o), lambda b, oh, k: (b, oh, 0, 0))
+    out_shape = jax.ShapeDtypeStruct((n, h, wd, o), x.dtype)
+    if with_stats:
+        out_specs = [out_specs,
+                     pl.BlockSpec((1, o), lambda b, oh, k: (0, 0)),
+                     pl.BlockSpec((1, o), lambda b, oh, k: (0, 0))]
+        out_shape = [out_shape,
+                     jax.ShapeDtypeStruct((1, o), jnp.float32),
+                     jax.ShapeDtypeStruct((1, o), jnp.float32)]
+    # stats outputs are revisited every grid step -> fully sequential
+    semantics = (("arbitrary",) * 3 if with_stats
+                 else ("parallel", "parallel", "arbitrary"))
     return pl.pallas_call(
         functools.partial(_fwd_kernel, kh_steps=kh, kw_steps=kw, ow=wd,
-                          fold_kw=fold_kw),
+                          fold_kw=fold_kw, with_stats=with_stats),
         grid=(n // bb, h, kh),
         in_specs=[
             pl.BlockSpec((bb, 1, wp, c), lambda b, oh, k: (b, oh + k, 0, 0)),
             pl.BlockSpec((kh, kw, c, o), lambda b, oh, k: (0, 0, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((bb, 1, wd, o),
-                               lambda b, oh, k: (b, oh, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, h, wd, o), x.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=scratch,
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=semantics),
         interpret=interpret,
     )(xp, w)
 
@@ -216,6 +248,23 @@ def conv2d_nhwc(x, w, padding: int, interpret: bool = False):
     """Stride-1 SAME NHWC conv, implicit-GEMM Pallas kernels end to end
     (forward + both backwards).  x (N, H, W, C), w (KH, KW, C, O)."""
     return _conv_fwd_impl(x, w, padding, interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("padding", "interpret"))
+def conv2d_bn_stats_nhwc(x, w, padding: int, interpret: bool = False):
+    """Fused conv + BN-statistics forward (the epilogue-fusion
+    experiment VERDICT r4 names; forward-only — training would pair it
+    with the round-4 backward kernels): returns (out, mean, var) with
+    the (O,) biased batch statistics over (N, H, W), exactly what
+    batch_norm training consumes."""
+    n, h, wd, _ = x.shape
+    o = w.shape[-1]
+    out, s_, sq = _conv_fwd_impl(x, w, padding, interpret,
+                                 with_stats=True)
+    cnt = jnp.float32(n * h * wd)
+    mean = (s_ / cnt).reshape(o)
+    var = (sq / cnt).reshape(o) - mean * mean
+    return out, mean, var
 
 
 def _conv_fwd_rule(x, w, padding, interpret):

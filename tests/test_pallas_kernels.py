@@ -375,6 +375,31 @@ def test_conv_kernel_numerics_and_grads(rng):
                                rtol=2e-4, atol=2e-3)
 
 
+def test_conv_bn_stats_fused_kernel(rng):
+    """Round-5 epilogue-fusion experiment: the fused conv+BN-stats
+    kernel's output and batch statistics match XLA conv + direct
+    mean/var (the composite the ResNet step executes)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.pallas.conv import conv2d_bn_stats_nhwc
+
+    N, H, W, C, O, K = 8, 14, 14, 256, 256, 3
+    x = jnp.asarray(rng.randn(N, H, W, C).astype(np.float32))
+    w = jnp.asarray(rng.randn(K, K, C, O).astype(np.float32) * 0.05)
+    out, mean, var = conv2d_bn_stats_nhwc(x, w, 1, interpret=True)
+    ref = jax.lax.conv_general_dilated(
+        x, w, (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(mean),
+                               np.asarray(ref.mean((0, 1, 2))), atol=1e-3)
+    np.testing.assert_allclose(np.asarray(var),
+                               np.asarray(ref.var((0, 1, 2))),
+                               atol=2e-2, rtol=1e-3)
+
+
 def test_conv2d_op_pallas_path_matches_xla(rng):
     """conv2d lowering dispatches to the pallas kernel under mode 'on'
     (interpret) and matches the XLA path."""
