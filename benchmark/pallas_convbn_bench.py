@@ -79,6 +79,8 @@ def chain(fn):
 
 
 def timed(jf, arg, steps=3):
+    # same discipline as benchmark/pallas_conv_bench.py::timed (R-chain
+    # amortization; kept in step with that file's methodology)
     out = float(jf(arg))
     t0 = time.perf_counter()
     for _ in range(steps):

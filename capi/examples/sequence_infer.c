@@ -1,0 +1,84 @@
+/* Sequence-model inference from pure C (reference:
+ * paddle/capi/examples/model_inference/sequence/main.c): load the
+ * quick_start text classifier saved by save_inference_model, feed a
+ * padded batch of word-id sequences plus their lengths, print the
+ * class probabilities per sequence.
+ *
+ * The padded-batch ABI replaces the reference's LoD argument: ids are
+ * a (B, T) int64 tensor fed under the data layer's name and the real
+ * lengths a (B,) tensor under "<name>@len" — the same layout the
+ * Python feeder produces.
+ *
+ * Build (see tests/test_capi.py::capi_native_binary — no libpython):
+ *   g++ -O2 sequence_infer.c -I.. -lpaddle_tpu_capi_native
+ * Run:  ./sequence_infer <model_dir> <id0> <id1> ...
+ */
+
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "../paddle_tpu_capi.h"
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <model_dir> <word_id>...\n", argv[0]);
+    return 2;
+  }
+  const char* model_dir = argv[1];
+  int64_t seq_len = argc - 2;
+
+  if (pd_init(NULL) != 0) {
+    fprintf(stderr, "init failed: %s\n", pd_last_error());
+    return 1;
+  }
+  pd_machine machine;
+  if (pd_machine_create_for_inference(&machine, model_dir) != 0) {
+    fprintf(stderr, "create failed: %s\n", pd_last_error());
+    return 1;
+  }
+
+  /* batch of 2: the full sequence, and its first half (exercises the
+   * lengths mask — padding past each row's length must not leak). */
+  int64_t half = seq_len / 2 > 0 ? seq_len / 2 : 1;
+  int64_t* ids = (int64_t*)calloc(2 * seq_len, sizeof(int64_t));
+  for (int64_t t = 0; t < seq_len; ++t) ids[t] = atoll(argv[2 + t]);
+  for (int64_t t = 0; t < half; ++t) ids[seq_len + t] = atoll(argv[2 + t]);
+  int64_t id_dims[2] = {2, seq_len};
+  int64_t lens[2];
+  lens[0] = seq_len;
+  lens[1] = half;
+  int64_t len_dims[1] = {2};
+
+  if (pd_machine_feed_i64(machine, "word", ids, id_dims, 2) != 0 ||
+      pd_machine_feed_i64(machine, "word@len", lens, len_dims, 1) != 0 ||
+      pd_machine_forward(machine) != 0) {
+    fprintf(stderr, "forward failed: %s\n", pd_last_error());
+    return 1;
+  }
+
+  int64_t odims[8];
+  int ondim = 8;
+  if (pd_machine_output_dims(machine, 0, odims, &ondim) != 0) {
+    fprintf(stderr, "dims failed: %s\n", pd_last_error());
+    return 1;
+  }
+  int64_t n = 1;
+  for (int i = 0; i < ondim; ++i) n *= odims[i];
+  float* out = (float*)malloc(sizeof(float) * n);
+  if (pd_machine_output_f32(machine, 0, out, n) != 0) {
+    fprintf(stderr, "output failed: %s\n", pd_last_error());
+    return 1;
+  }
+  int64_t classes = ondim >= 2 ? odims[ondim - 1] : n;
+  for (int64_t b = 0; b < n / classes; ++b) {
+    printf("probs[%lld]:", (long long)b);
+    for (int64_t c = 0; c < classes; ++c)
+      printf(" %.6f", out[b * classes + c]);
+    printf("\n");
+  }
+  free(ids);
+  free(out);
+  pd_machine_destroy(machine);
+  return 0;
+}

@@ -8,12 +8,14 @@
 // capi examples deploy dense AND conv models:
 // capi/examples/model_inference/).  The embedded-CPython
 // implementation (paddle_tpu_capi.cc) remains the full-surface
-// fallback; this library covers the exported MLP + convnet op set
-// (mul, elementwise add/mul/sub with paddle axis broadcast, conv2d,
-// pool2d max/avg, relu/sigmoid/tanh/softmax/scale, reshape,
-// dropout/batch_norm in inference form) — enough for LeNet-class
-// image models — and fails with a clear error naming any op outside
-// it.
+// fallback; this library covers the exported MLP + convnet + sequence
+// op set (mul, elementwise add/mul/sub with paddle axis broadcast,
+// conv2d, pool2d max/avg, relu/sigmoid/tanh/softmax/scale, reshape,
+// dropout/batch_norm in inference form, lookup_table,
+// context_project, padded_sequence_pool) — enough for LeNet-class
+// image models AND the quick_start text classifier (reference bar:
+// capi/examples/model_inference/sequence/main.c) — and fails with a
+// clear error naming any op outside it.
 //
 // Build:   g++ -O2 -shared -fPIC -o libpaddle_tpu_capi_native.so \
 //              paddle_tpu_capi_native.cc
@@ -289,6 +291,12 @@ std::string OutName(const Json& op, const char* slot) {
   return names->arr[0].str;
 }
 
+std::string AttrStr(const Json& op, const char* key, const char* dflt) {
+  const Json* attrs = op.Get("attrs");
+  const Json* v = attrs ? attrs->Get(key) : nullptr;
+  return (v && v->kind == Json::kStr) ? v->str : std::string(dflt);
+}
+
 double AttrNum(const Json& op, const char* key, double dflt) {
   const Json* attrs = op.Get("attrs");
   if (!attrs) return dflt;
@@ -330,8 +338,21 @@ int RunOp(Machine* m, const Json& op) {
     int64_t k = y->dims[0];
     int64_t n = y->dims[1];
     int64_t mrows = x->numel() / k;
+    // leading dims up to x_num_col_dims survive (a (B, T, D) fc input
+    // keeps its time axis: out (B, T, n) — sequence pools downstream
+    // need the structure)
+    int ncol = static_cast<int>(AttrNum(op, "x_num_col_dims", 1));
     Tensor out;
-    out.dims = {mrows, n};
+    if (ncol >= 1 && ncol < static_cast<int>(x->dims.size())) {
+      int64_t lead = 1, tail = 1;
+      for (int i = 0; i < ncol; ++i) lead *= x->dims[i];
+      for (size_t i = ncol; i < x->dims.size(); ++i) tail *= x->dims[i];
+      if (tail == k && lead == mrows) {
+        out.dims.assign(x->dims.begin(), x->dims.begin() + ncol);
+        out.dims.push_back(n);
+      }
+    }
+    if (out.dims.empty()) out.dims = {mrows, n};
     out.data.assign(mrows * n, 0.f);
     for (int64_t i = 0; i < mrows; ++i)
       for (int64_t kk = 0; kk < k; ++kk) {
@@ -536,6 +557,129 @@ int RunOp(Machine* m, const Json& op) {
         else known *= d;
       }
       if (wild >= 0) out.dims[wild] = x->numel() / known;
+    }
+    m->values[OutName(op, "Out")] = std::move(out);
+    return 0;
+  }
+  if (type == "lookup_table") {
+    // embedding gather (reference: capi sequence example's embedding;
+    // python twin ops/tensor_ops.py _lookup_table): Ids (..., 1) ->
+    // Out (squeezed..., E); padding_idx rows zeroed
+    Tensor* w = val("W");
+    Tensor* ids = val("Ids");
+    if (!w || !ids) return Fail("lookup_table: missing input");
+    int64_t vocab = w->dims[0];
+    int64_t e = w->dims[1];
+    std::vector<int64_t> odims(ids->dims);
+    if (!odims.empty() && odims.back() == 1) odims.pop_back();
+    int64_t rows = 1;
+    for (int64_t d : odims) rows *= d;
+    odims.push_back(e);
+    Tensor out;
+    out.dims = odims;
+    out.data.resize(rows * e, 0.f);
+    double pad_idx = AttrNum(op, "padding_idx", -1);
+    for (int64_t r = 0; r < rows; ++r) {
+      int64_t id = static_cast<int64_t>(ids->data[r]);
+      if (id < 0 || id >= vocab)
+        return Fail("lookup_table: id out of range");
+      if (pad_idx >= 0 && id == static_cast<int64_t>(pad_idx)) continue;
+      std::copy(w->data.begin() + id * e, w->data.begin() + (id + 1) * e,
+                out.data.begin() + r * e);
+    }
+    m->values[OutName(op, "Out")] = std::move(out);
+    return 0;
+  }
+  if (type == "context_project") {
+    // sliding-window concat over time (python twin
+    // ops/sequence_ops.py _context_project): X (B, T, D) ->
+    // (B, T, D*L), position t reads steps [t+start, t+start+L) with
+    // zero padding past the batch's time bounds
+    Tensor* x = val("X");
+    if (!x || x->dims.size() != 3)
+      return Fail("context_project: need (B, T, D) input");
+    int64_t ctx_len =
+        static_cast<int64_t>(AttrNum(op, "context_length", 0));
+    if (ctx_len <= 0) return Fail("context_project: bad context_length");
+    int64_t start = static_cast<int64_t>(
+        AttrNum(op, "context_start", -(ctx_len / 2)));
+    int64_t bsz = x->dims[0], tlen = x->dims[1], d = x->dims[2];
+    Tensor out;
+    out.dims = {bsz, tlen, d * ctx_len};
+    out.data.assign(bsz * tlen * d * ctx_len, 0.f);
+    for (int64_t b = 0; b < bsz; ++b)
+      for (int64_t t = 0; t < tlen; ++t)
+        for (int64_t k = 0; k < ctx_len; ++k) {
+          int64_t src = t + start + k;
+          if (src < 0 || src >= tlen) continue;
+          const float* sp = &x->data[(b * tlen + src) * d];
+          float* dp =
+              &out.data[((b * tlen + t) * ctx_len + k) * d];
+          std::copy(sp, sp + d, dp);
+        }
+    m->values[OutName(op, "Out")] = std::move(out);
+    return 0;
+  }
+  if (type == "padded_sequence_pool") {
+    // masked pool over padded (B, T, D) + lengths (B,) (python twin
+    // ops/sequence_ops.py _padded_sequence_pool)
+    Tensor* x = val("X");
+    Tensor* len = val("Length");
+    if (!x || !len || x->dims.size() < 2)
+      return Fail("padded_sequence_pool: missing/low-rank input");
+    std::string pts = AttrStr(op, "pooltype", "AVERAGE");
+    for (auto& ch : pts) ch = std::toupper(ch);
+    if (pts == "AVG") pts = "AVERAGE";
+    enum Pool { kMax, kSum, kAvg, kSqrt, kLast, kFirst };
+    Pool pt;
+    if (pts == "MAX") pt = kMax;
+    else if (pts == "SUM") pt = kSum;
+    else if (pts == "AVERAGE") pt = kAvg;
+    else if (pts == "SQRT") pt = kSqrt;
+    else if (pts == "LAST") pt = kLast;
+    else if (pts == "FIRST") pt = kFirst;
+    else return Fail("padded_sequence_pool: pooltype " + pts);
+    int64_t bsz = x->dims[0], tlen = x->dims[1];
+    int64_t d = x->numel() / (bsz * tlen);
+    Tensor out;
+    out.dims = {bsz, d};
+    out.data.assign(bsz * d, 0.f);
+    for (int64_t b = 0; b < bsz; ++b) {
+      int64_t L = static_cast<int64_t>(len->data[b]);
+      if (L > tlen) L = tlen;
+      for (int64_t j = 0; j < d; ++j) {
+        float acc;
+        // length-0 rows follow the Python twin exactly
+        // (ops/sequence_ops.py _masked_pool: MAX of an empty mask is
+        // the -1e9 sentinel; LAST/FIRST clamp to row 0)
+        switch (pt) {
+          case kLast:
+            acc = x->data[(b * tlen + (L > 0 ? L - 1 : 0)) * d + j];
+            break;
+          case kFirst:
+            acc = x->data[(b * tlen) * d + j];
+            break;
+          case kMax: {
+            acc = -1e9f;
+            for (int64_t t = 0; t < L; ++t) {
+              float v = x->data[(b * tlen + t) * d + j];
+              acc = v > acc ? v : acc;
+            }
+            break;
+          }
+          default: {
+            acc = 0.f;
+            for (int64_t t = 0; t < L; ++t)
+              acc += x->data[(b * tlen + t) * d + j];
+            if (L > 0) {
+              if (pt == kAvg) acc /= static_cast<float>(L);
+              else if (pt == kSqrt)
+                acc /= std::sqrt(static_cast<float>(L));
+            }
+          }
+        }
+        out.data[b * d + j] = acc;
+      }
     }
     m->values[OutName(op, "Out")] = std::move(out);
     return 0;
