@@ -15,6 +15,7 @@ per-layer interpreter loop.
 from __future__ import annotations
 
 import math
+import os
 from typing import Optional
 
 from paddle_tpu.trainer_config_helpers.activations import (
@@ -1287,6 +1288,67 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
     parents = seq_ins + [s.input for s in static_ins] + boot_parents
     group_key = f"@group_{name or _v2._uname('rg')}"
 
+    # -- scan-epilogue hoisting (TPU-first optimization) --------------
+    # A step-output layer that no memory depends on is a pure map over
+    # per-step values: computing it INSIDE the scan runs its matmul at
+    # M=B per step (the MXU-starving shape recurrence forces), while
+    # computing it AFTER the scan runs one (B*T, D) matmul.  For the
+    # canonical attention decoder the hoisted node is the vocab
+    # projection — the dominant FLOPs of the whole step — and the scan
+    # carry shrinks from (B, V) to (B, H) per step.  The reference
+    # interprets the full step per time step
+    # (RecurrentGradientMachine.cpp:530); a compiled scan can split it.
+    # Hoist one level: output o moves past the scan iff nothing a
+    # memory links to depends on it, its layer type is known
+    # rank-polymorphic over a leading time axis, and each of its
+    # parents is computed in-scan (emitted) or is a group input
+    # (full sequences are available post-scan anyway).
+    _HOIST_SAFE_TYPES = {"fc", "mixed"}
+    mem_needed = set()
+
+    def _mark_needed(lo):
+        if id(lo) in mem_needed:
+            return
+        mem_needed.add(id(lo))
+        for p in lo.parents:
+            _mark_needed(p)
+
+    for m in memories:
+        linked = by_name.get(m._mem_link)
+        if linked is not None:
+            _mark_needed(linked)
+        mem_needed.add(id(m))
+
+    ph_ids = {id(p) for p in placeholders} | {id(p) for p in static_phs}
+    hoist_enabled = (os.environ.get("PADDLE_TPU_RG_HOIST", "1") == "1"
+                     and not reverse)
+
+    def _hoistable(o):
+        entry = getattr(o, "_cfg_entry", None)
+        if (not hoist_enabled or id(o) in mem_needed
+                or entry is None or entry.get("type") not in
+                _HOIST_SAFE_TYPES):
+            return False
+        return all(id(p) in mem_needed or id(p) in ph_ids
+                   for p in o.parents)
+
+    hoisted = [o for o in outs if _hoistable(o)]
+    # scan emits: parents of hoisted outputs that live in the scan,
+    # plus every non-hoisted output
+    emit, emit_ids = [], set()
+    for o in outs:
+        if o in hoisted:
+            for p in o.parents:
+                # group inputs are whole sequences post-scan already —
+                # only scan-computed parents need emitting
+                if (id(p) in mem_needed and id(p) not in ph_ids
+                        and id(p) not in emit_ids):
+                    emit.append(p)
+                    emit_ids.add(id(p))
+        elif id(o) not in emit_ids:
+            emit.append(o)
+            emit_ids.add(id(o))
+
     def run_group(ctx, *vals):
         from paddle_tpu import layers as L
 
@@ -1334,7 +1396,7 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
                 sub_ctx[id(m)] = mv
                 mem_vars.append(mv)
             out_vars = []
-            for o in outs:
+            for o in emit:
                 ov = o.build(sub_ctx)
                 ov = ov.var if isinstance(ov, SeqVal) else ov
                 out_vars.append(ov)
@@ -1360,7 +1422,22 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
                     lv = _masked(sub_ctx, lv, "last")
                 rnn.update_memory(mv, lv)
         results = rnn()
-        ctx[group_key] = [SeqVal(r, lengths) for r in results]
+        # post-scan: seed the emitted nodes' full (B, T, ...) sequences
+        # and the group inputs, then build each hoisted output over the
+        # whole sequence (one big matmul instead of T small ones)
+        post_ctx = {}
+        for node, r in zip(emit, results):
+            post_ctx[id(node)] = SeqVal(r, lengths)
+        for ph, sv in zip(placeholders, seq_vals):
+            post_ctx[id(ph)] = sv
+        for ph, v in zip(static_phs, static_vals):
+            post_ctx[id(ph)] = v
+        finals = []
+        for o in outs:
+            v = o.build(post_ctx)
+            finals.append(v if isinstance(v, SeqVal)
+                          else SeqVal(v, lengths))
+        ctx[group_key] = finals
 
     group_outs = []
     for i, o in enumerate(outs):

@@ -349,3 +349,94 @@ def test_v2_infer_with_beam_gen():
                        field="id")
     assert len(ids) == 1
     assert all(0 <= t < V for t in ids[0])
+
+
+def test_scan_epilogue_hoist_matches_in_scan(monkeypatch):
+    """The hoisted vocab-projection path (memory-independent step
+    output computed post-scan over (B, T, .)) must match the in-scan
+    computation exactly — same program semantics, different schedule."""
+    import os
+
+    import numpy as np
+
+    import paddle_tpu as fluid
+    import paddle_tpu.executor as em
+    from paddle_tpu.trainer.config_parser import parse_config
+    from paddle_tpu.v2.data_type import integer_value_sequence
+    from paddle_tpu.v2.topology import Topology
+
+    def run(hoist):
+        monkeypatch.setenv("PADDLE_TPU_RG_HOIST", "1" if hoist else "0")
+        fluid.framework.reset_default_programs()
+        em._global_scope = em.Scope()
+        em._scope_stack = [em._global_scope]
+        import paddle_tpu.v2.layer as v2_layer
+
+        v2_layer._counter[0] = 0
+        holder = {}
+
+        def config():
+            from paddle_tpu.trainer_config_helpers import (
+                LinearActivation, ParamAttr, SoftmaxActivation,
+                StaticInput, classification_cost, data_layer,
+                embedding_layer, fc_layer, grumemory, memory, outputs,
+                recurrent_group, settings)
+            from paddle_tpu.trainer_config_helpers.layers_extra import \
+                gru_step_layer
+
+            settings(batch_size=4, learning_rate=0.1)
+            src = data_layer(name="src", size=12)
+            emb = embedding_layer(input=src, size=6,
+                                  param_attr=ParamAttr(name="emb_w"))
+            enc = grumemory(input=fc_layer(
+                input=emb, size=24, act=LinearActivation(),
+                bias_attr=False, param_attr=ParamAttr(name="ew")),
+                size=8, name="enc")
+
+            def step(word, enc_states):
+                mem = memory(name="dec", size=8)
+                inp = fc_layer(input=[word, mem], size=24,
+                               act=LinearActivation(), bias_attr=False,
+                               param_attr=[ParamAttr(name="iw"),
+                                           ParamAttr(name="mw")])
+                dec = gru_step_layer(input=inp, output_mem=mem, size=8,
+                                     name="dec",
+                                     param_attr=ParamAttr(name="gw"))
+                return fc_layer(input=dec, size=12,
+                                act=SoftmaxActivation(),
+                                param_attr=ParamAttr(name="ow"),
+                                bias_attr=False)
+
+            trg = data_layer(name="trg", size=12)
+            lab = data_layer(name="lab", size=12)
+            temb = embedding_layer(input=trg, size=6,
+                                   param_attr=ParamAttr(name="temb"))
+            probs = recurrent_group(
+                step=step, input=[temb, StaticInput(enc, is_seq=True,
+                                                    size=8)])
+            holder["probs"] = probs
+            outputs(classification_cost(input=probs, label=lab))
+
+        conf = parse_config(config)
+        for n in ("src", "trg", "lab"):
+            conf.data_layers[n].input_type = integer_value_sequence(12)
+        topo = Topology(conf.cost)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = em.Scope()
+        rng = np.random.RandomState(0)
+        B, T = 3, 5
+        feed = {"src": rng.randint(0, 12, (B, T)).astype("int64"),
+                "src@len": np.array([5, 4, 2], np.int32),
+                "trg": rng.randint(0, 12, (B, T)).astype("int64"),
+                "trg@len": np.array([5, 4, 2], np.int32),
+                "lab": rng.randint(0, 12, (B, T)).astype("int64"),
+                "lab@len": np.array([5, 4, 2], np.int32)}
+        with em.scope_guard(scope):
+            exe.run(topo.startup_program)
+            (cost,) = exe.run(topo.main_program, feed=feed,
+                              fetch_list=[topo.cost_var.name])
+        return float(np.asarray(cost).reshape(-1)[0])
+
+    on = run(True)
+    off = run(False)
+    np.testing.assert_allclose(on, off, rtol=1e-6)
