@@ -104,8 +104,12 @@ class Trainer:
             if isinstance(e, v2_event.EndIteration):
                 costs.append(e.cost)
                 if e.batch_id % log_period == 0:
+                    evals = "".join(f" {n}={v:.6g}"
+                                    for n, v in sorted(e.metrics.items()))
                     print(f"Pass {e.pass_id}, Batch {e.batch_id}, "
-                          f"Cost {e.cost:.6f}", flush=True)
+                          f"Cost {e.cost:.6f}"
+                          + (f", Eval:{evals}" if evals else ""),
+                          flush=True)
             if isinstance(e, v2_event.EndPass) and save_dir:
                 pass_dir = os.path.join(save_dir, f"pass-{e.pass_id:05d}")
                 os.makedirs(pass_dir, exist_ok=True)
@@ -286,9 +290,11 @@ def main(argv=None):
             t.load_parameters(a.init_model_path)
         result = t.test()
         dt = time.time() - t0
+        evals = "".join(f" {n}={v:.6g}"
+                        for n, v in sorted(result.metrics.items()))
         print(f"Test done in {dt:.1f}s, cost "
-              f"{result.cost if result.cost is not None else float('nan'):.6f}",
-              flush=True)
+              f"{result.cost if result.cost is not None else float('nan'):.6f}"
+              + (f", Eval:{evals}" if evals else ""), flush=True)
         return 0
     if a.job == "checkgrad":
         conf = parse_config(a.config, a.config_args)

@@ -21,9 +21,16 @@ __all__ = [
 ]
 
 
-def _eval_layer(name_prefix, parents, build, size=1):
+def _eval_layer(name_prefix, parents, build, size=1, display=None,
+                metric=True):
     lo = LayerOutput(_layers._v2._uname(name_prefix), parents, build,
                      size=size)
+    if metric:
+        # the name the trainer prints per batch (reference
+        # TrainerInternal: "Eval: classification_error_evaluator=0.4486");
+        # printer evaluators work via in-graph side effects and are
+        # NOT fetched host-side per step
+        lo._eval_name = display or f"{name_prefix}_evaluator"
     cap = _layers._g_capture
     if cap is not None:
         cap.setdefault("evaluators", []).append(lo)
@@ -37,7 +44,8 @@ def classification_error_evaluator(input, label, name=None, **kwargs):
         acc = L.accuracy(input=pred, label=lab)
         return L.scale(acc, scale=-1.0, bias=1.0)  # error = 1 - accuracy
 
-    return _eval_layer("classification_error", [input, label], build)
+    return _eval_layer("classification_error", [input, label], build,
+                       display=name)
 
 
 def auc_evaluator(input, label, name=None, **kwargs):
@@ -48,7 +56,7 @@ def auc_evaluator(input, label, name=None, **kwargs):
         return _op("auc", {"Out": [pred], "Indices": [pred], "Label": [lab]},
                    out_slot="AUC")
 
-    return _eval_layer("auc", [input, label], build)
+    return _eval_layer("auc", [input, label], build, display=name)
 
 
 def chunk_evaluator(input, label, chunk_scheme: str = "IOB",
@@ -61,7 +69,8 @@ def chunk_evaluator(input, label, chunk_scheme: str = "IOB",
                           "num_chunk_types": num_chunk_types},
                    out_slot="F1-Score")
 
-    return _eval_layer("chunk_f1", [input, label], build)
+    return _eval_layer("chunk_f1", [input, label], build,
+                       display=name)
 
 
 def precision_recall_evaluator(input, label, name=None, **kwargs):
@@ -77,7 +86,8 @@ def precision_recall_evaluator(input, label, name=None, **kwargs):
                    attrs={"class_number": num_classes},
                    out_slot="BatchMetrics")
 
-    return _eval_layer("precision_recall", [input, label], build)
+    return _eval_layer("precision_recall", [input, label], build,
+                       display=name)
 
 
 def pnpair_evaluator(input, label, query_id, name=None, **kwargs):
@@ -88,7 +98,8 @@ def pnpair_evaluator(input, label, query_id, name=None, **kwargs):
                    {"Score": [score], "Label": [lab], "QueryID": [qid]},
                    out_slot="PositivePair")
 
-    return _eval_layer("pnpair", [input, label, query_id], build)
+    return _eval_layer("pnpair", [input, label, query_id], build,
+                       display=name)
 
 
 def sum_evaluator(input, name=None, weight=None, **kwargs):
@@ -114,7 +125,7 @@ def sum_evaluator(input, name=None, weight=None, **kwargs):
         # sum / batch_size == sum over features of the per-column mean
         return L.reduce_sum(L.reduce_mean(v, dim=0), reduce_all=True)
 
-    return _eval_layer("sum", parents, build)
+    return _eval_layer("sum", parents, build, display=name)
 
 
 def column_sum_evaluator(input, name=None, weight=None, **kwargs):
@@ -141,7 +152,7 @@ def column_sum_evaluator(input, name=None, weight=None, **kwargs):
             return L.elementwise_div(x=num, y=den)
         return L.reduce_mean(last, reduce_all=True)
 
-    return _eval_layer("column_sum", parents, build)
+    return _eval_layer("column_sum", parents, build, display=name)
 
 
 def _as_list(input):
@@ -164,7 +175,7 @@ def value_printer_evaluator(input, name=None, **kwargs):
                       {"message": f"{name or 'value_printer'}:{lo.name}"})
         return out
 
-    return _eval_layer("value_printer", inputs, build)
+    return _eval_layer("value_printer", inputs, build, metric=False)
 
 
 def gradient_printer_evaluator(input, name=None, **kwargs):
@@ -217,7 +228,8 @@ def maxid_printer_evaluator(input, num_results=None, name=None, **kwargs):
             out = _op("print", {"X": [idx]}, {"message": tag + " top-ids"})
         return out
 
-    return _eval_layer("maxid_printer", inputs, build)
+    return _eval_layer("maxid_printer", inputs, build,
+                       metric=False)
 
 
 def maxframe_printer_evaluator(input, num_results=None, name=None, **kwargs):
@@ -249,7 +261,8 @@ def maxframe_printer_evaluator(input, num_results=None, name=None, **kwargs):
             out = _op("print", {"X": [top]}, {"message": tag + " top-frames"})
         return out
 
-    return _eval_layer("maxframe_printer", inputs, build)
+    return _eval_layer("maxframe_printer", inputs, build,
+                       metric=False)
 
 
 def seqtext_printer_evaluator(input, result_file, id_input=None,
@@ -274,7 +287,8 @@ def seqtext_printer_evaluator(input, result_file, id_input=None,
                     "delimited": (True if delimited is None
                                   else bool(delimited))}, dtype="int64")
 
-    return _eval_layer("seqtext_printer", parents, build)
+    return _eval_layer("seqtext_printer", parents, build,
+                       metric=False)
 
 
 def classification_error_printer_evaluator(input, label, threshold=0.5,
@@ -304,7 +318,8 @@ def classification_error_printer_evaluator(input, label, threshold=0.5,
         return _op("print", {"X": [err]},
                    {"message": name or "classification_error_printer"})
 
-    return _eval_layer("classification_error_printer", [input, label], build)
+    return _eval_layer("classification_error_printer",
+                       [input, label], build, metric=False)
 
 
 def _warn_if_declarative(fn_name):

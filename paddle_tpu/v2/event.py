@@ -34,6 +34,7 @@ class EndIteration(WithMetric):
 
 
 class TestResult(WithMetric):
-    def __init__(self, evaluator=None, cost=None):
+    def __init__(self, evaluator=None, cost=None, metrics=None):
         self.cost = cost
+        self.metrics = metrics or {}
         super().__init__(evaluator)

@@ -19,6 +19,7 @@ class Topology:
         if cost is not None:
             outputs = [cost] + outputs
         outputs += list(extra_layers or [])
+        self.output_layers = outputs  # LayerOutputs, same order as output_vars
         self.cost = cost
         self.main_program = framework.Program()
         self.startup_program = framework.Program()

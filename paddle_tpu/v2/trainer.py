@@ -164,6 +164,38 @@ class SGD:
             self.parameters.set(
                 pname, fresh[pname].reshape(self.parameters.get_shape(pname)))
 
+    def _metric_fetch(self):
+        """(fetch_list, metric_names): the cost plus every evaluator
+        output tagged with a display name, names deduplicated with
+        _0/_1 suffixes (the reference's wrap_name_default behavior for
+        repeated evaluator types).  Shared by train() and test() so
+        the two paths cannot diverge."""
+        fetch = [self.topology.cost_var]
+        names = []
+        seen = {}
+        for lo, var in zip(getattr(self.topology, "output_layers", []),
+                           self.topology.output_vars):
+            ename = getattr(lo, "_eval_name", None)
+            if ename is None:
+                continue
+            if ename in seen:
+                seen[ename] += 1
+                ename = f"{ename}_{seen[ename]}"
+            else:
+                seen[ename] = 0
+            fetch.append(var)
+            names.append(ename)
+        return fetch, names
+
+    @staticmethod
+    def _scalar_metrics(names, vals):
+        out = {}
+        for nm, v in zip(names, vals):
+            arr = np.asarray(v)
+            if arr.size == 1:
+                out[nm] = float(arr.reshape(()))
+        return out
+
     def _remote_step(self, feed, fetch):
         """One batch against the pserver: local fwd/bwd, ship grads,
         pull fresh params (RemoteParameterUpdater.finishBatch order)."""
@@ -171,7 +203,7 @@ class SGD:
         with executor_mod.scope_guard(self.parameters.scope):
             outs = self._exe.run(self.topology.main_program, feed=feed,
                                  fetch_list=fetch + grad_names)
-        cost = outs[0]
+        fetched = outs[:len(fetch)]
         grads = outs[len(fetch):]
         payload = {}
         for (pname, _), g in zip(self._param_grads, grads):
@@ -186,7 +218,7 @@ class SGD:
                 payload[pname] = np.asarray(g)
         self._remote.send_grads(payload)
         self._pull_params()
-        return cost
+        return fetched
 
     def train(self, reader: Callable, num_passes: int = 1,
               event_handler: Optional[Callable] = None,
@@ -203,33 +235,46 @@ class SGD:
         protocol needs the synchronous loop."""
         event_handler = event_handler or (lambda e: None)
         feeder = V2DataFeeder(self.topology.feed_types, feeding)
-        fetch = [self.topology.cost_var]
+        # evaluator outputs ride the same fetch (reference
+        # TrainerInternal prints "Eval: name=value" per log period)
+        fetch, metric_names = self._metric_fetch()
+
+        def metrics_of(vals):
+            return self._scalar_metrics(metric_names, vals)
+
         for pass_id in range(num_passes):
             event_handler(v2_event.BeginPass(pass_id))
             if prefetch and self._remote is None:
-                self._train_pass_prefetch(reader, feeder, fetch, pass_id,
+                self._train_pass_prefetch(reader, feeder, fetch,
+                                          metric_names, pass_id,
                                           event_handler)
             else:
                 for batch_id, data in enumerate(reader()):
                     event_handler(v2_event.BeginIteration(pass_id, batch_id))
                     feed = feeder.feed(data)
                     if self._remote is not None:
-                        cost = self._remote_step(feed, fetch)
+                        cost, *extra_vals = self._remote_step(feed, fetch)
                     else:
                         with executor_mod.scope_guard(self.parameters.scope):
-                            (cost,) = self._exe.run(
+                            cost, *extra_vals = self._exe.run(
                                 self.topology.main_program,
                                 feed=feed, fetch_list=fetch)
                     event_handler(v2_event.EndIteration(
                         pass_id, batch_id,
-                        float(np.asarray(cost).reshape(-1)[0])))
+                        float(np.asarray(cost).reshape(-1)[0]),
+                        metrics=metrics_of(extra_vals)))
             event_handler(v2_event.EndPass(pass_id))
 
-    def _train_pass_prefetch(self, reader, feeder, fetch, pass_id,
-                             event_handler):
+    def _train_pass_prefetch(self, reader, feeder, fetch, metric_names,
+                             pass_id, event_handler):
         import jax
 
-        pending = None  # (batch_id, device cost)
+        def emit(pid, pcost, pextra):
+            event_handler(v2_event.EndIteration(
+                pass_id, pid, float(np.asarray(pcost).reshape(-1)[0]),
+                metrics=self._scalar_metrics(metric_names, pextra)))
+
+        pending = None  # (batch_id, device cost, device evaluator outs)
         try:
             it = enumerate(reader())
             nxt = next(it, None)
@@ -241,39 +286,52 @@ class SGD:
                 batch_id, _ = nxt
                 event_handler(v2_event.BeginIteration(pass_id, batch_id))
                 with executor_mod.scope_guard(self.parameters.scope):
-                    (cost,) = self._exe.run(self.topology.main_program,
-                                            feed=staged, fetch_list=fetch,
-                                            return_numpy=False)
+                    cost, *extra = self._exe.run(self.topology.main_program,
+                                                 feed=staged,
+                                                 fetch_list=fetch,
+                                                 return_numpy=False)
                 # stage batch N+1 while the device executes step N
                 nxt = next(it, None)
                 if nxt is not None:
                     staged = {k: jax.device_put(v)
                               for k, v in feeder.feed(nxt[1]).items()}
                 if pending is not None:
-                    pid, pcost = pending
+                    args = pending
                     pending = None  # consume BEFORE emitting: a raising
                     # handler must not see the event again from finally
-                    event_handler(v2_event.EndIteration(
-                        pass_id, pid,
-                        float(np.asarray(pcost).reshape(-1)[0])))
-                pending = (batch_id, cost)
+                    emit(*args)
+                pending = (batch_id, cost, extra)
         finally:
             # a failure in step N must not drop step N-1's completed
             # EndIteration (handlers checkpoint/log on it)
             if pending is not None:
-                pid, pcost = pending
-                event_handler(v2_event.EndIteration(
-                    pass_id, pid, float(np.asarray(pcost).reshape(-1)[0])))
+                emit(*pending)
 
     def test(self, reader: Callable, feeding: Optional[Dict[str, int]] = None):
         if self._test_program is None:
             self._test_program = self.topology.main_program.clone(for_test=True)
         feeder = V2DataFeeder(self.topology.feed_types, feeding)
+        # scalar evaluator outputs are sample-weight averaged over the
+        # test pass (reference Tester::testOneBatch accumulates)
+        fetch, metric_names = self._metric_fetch()
         costs = []
+        sums: Dict[str, float] = {}
+        n_samples = 0
         for data in reader():
             feed = feeder.feed(data)
             with executor_mod.scope_guard(self.parameters.scope):
-                (cost,) = self._exe.run(self._test_program, feed=feed,
-                                        fetch_list=[self.topology.cost_var])
+                cost, *extra = self._exe.run(self._test_program, feed=feed,
+                                             fetch_list=fetch)
             costs.append(float(np.asarray(cost).reshape(-1)[0]))
-        return v2_event.TestResult(cost=float(np.mean(costs)) if costs else None)
+            # sample-weighted accumulation (reference Tester accumulates
+            # evaluator totals by sample count, not by batch)
+            bsz = len(data)
+            n_samples += bsz
+            for nm, v in zip(metric_names, extra):
+                arr = np.asarray(v)
+                if arr.size == 1:
+                    sums[nm] = sums.get(nm, 0.0) + float(arr.reshape(())) * bsz
+        metrics = ({nm: s / n_samples for nm, s in sums.items()}
+                   if n_samples else {})
+        return v2_event.TestResult(
+            cost=float(np.mean(costs)) if costs else None, metrics=metrics)

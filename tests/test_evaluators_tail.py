@@ -317,3 +317,91 @@ def test_all_sixteen_reference_evaluator_names_resolve():
     for n in ref_names:
         assert callable(getattr(ev, n)), n
         assert n in ev.__all__, n
+
+
+def test_trainer_prints_eval_line(tmp_path, capfd):
+    """The v1 trainer log matches the reference TrainerInternal format:
+    "Pass P, Batch B, Cost c, Eval: name=value ..." with scalar
+    evaluator values fetched every step."""
+    import sys
+
+    from paddle_tpu.trainer import train_from_config
+
+    d = tmp_path
+    (d / "prov.py").write_text(
+        "import numpy as np\n"
+        "def process(fname):\n"
+        "    r = np.random.RandomState(0)\n"
+        "    for _ in range(32):\n"
+        "        y = int(r.randint(0, 3))\n"
+        "        x = np.zeros(6, np.float32); x[y*2:y*2+2] = 1.0\n"
+        "        yield {'x': x + 0.1*r.randn(6).astype(np.float32),\n"
+        "               'lab': y}\n")
+    (d / "conf.py").write_text(
+        "from paddle_tpu.trainer_config_helpers import *\n"
+        "define_py_data_sources2(train_list='32', test_list=None,\n"
+        "                        module='prov', obj='process')\n"
+        "settings(batch_size=16, learning_rate=0.1)\n"
+        "x = data_layer(name='x', size=6)\n"
+        "lab = data_layer(name='lab', size=3)\n"
+        "pred = fc_layer(input=x, size=3, act=SoftmaxActivation())\n"
+        "classification_error_evaluator(input=pred, label=lab)\n"
+        "sum_evaluator(input=pred, name='psum')\n"
+        "outputs(classification_cost(input=pred, label=lab))\n")
+    sys.path.insert(0, str(d))
+    try:
+        train_from_config(str(d / "conf.py"), num_passes=1, log_period=1)
+    finally:
+        sys.path.remove(str(d))
+    out = capfd.readouterr().out
+    line = [l for l in out.splitlines() if "Eval:" in l][0]
+    assert "classification_error_evaluator=" in line
+    assert "psum=" in line
+
+
+def test_prefetch_train_with_evaluator_metrics():
+    """The double-buffered prefetch path must carry evaluator metrics
+    through its deferred sync (review regression: the grown fetch list
+    crashed the single-value unpack, and metrics were dropped)."""
+    import paddle_tpu.v2 as paddle
+
+    paddle.init()
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    y = paddle.layer.data(name="y", type=paddle.data_type.integer_value(3))
+    pred = paddle.layer.fc(input=x, size=3,
+                           act=paddle.activation.Softmax())
+    from paddle_tpu.trainer_config_helpers.evaluators import \
+        classification_error_evaluator
+
+    ev = classification_error_evaluator(input=pred, label=y)
+    cost = paddle.layer.classification_cost(input=pred, label=y)
+    topo_extra = [ev]
+    from paddle_tpu.v2.topology import Topology
+    from paddle_tpu.v2.parameters import Parameters
+
+    topo = Topology(cost, extra_layers=topo_extra)
+    params = Parameters(topo)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Momentum(momentum=0.9,
+                                                  learning_rate=1e-2))
+
+    def reader():
+        r = np.random.RandomState(0)
+        for _ in range(24):
+            yield r.randn(4).astype(np.float32), int(r.randint(0, 3))
+
+    seen = []
+
+    def handler(e):
+        import paddle_tpu.v2.event as ev_mod
+
+        if isinstance(e, ev_mod.EndIteration):
+            seen.append(dict(e.metrics))
+
+    trainer.train(reader=paddle.batch(reader, batch_size=8),
+                  num_passes=1, event_handler=handler, prefetch=True)
+    assert len(seen) == 3
+    assert all("classification_error_evaluator" in m for m in seen), seen
+    assert all(0.0 <= m["classification_error_evaluator"] <= 1.0
+               for m in seen)
