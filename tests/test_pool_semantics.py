@@ -1,0 +1,117 @@
+"""Runtime semantics of the round-5 pool changes: ceil-mode output
+extents and exclude-mode averaging (reference: config_parser
+cnn_output_size caffe_mode=False + PoolLayer.cpp:49 excludeMode_
+default true), checked forward AND backward against a direct
+lax.reduce_window reference across kernel/stride/padding sweeps."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+sys.path.insert(0, os.path.dirname(__file__))
+from op_test import OpTest  # noqa: E402
+
+from paddle_tpu.layers.nn import pool_extra_padding, pool_out_extent
+
+
+CASES = [
+    # (ptype, k, s, p, ceil, exclusive)
+    ("max", 3, 1, 1, False, False),
+    ("max", 2, 2, 0, False, False),
+    ("max", 3, 2, 1, True, False),   # the v1 img_pool default shape
+    ("avg", 3, 1, 1, False, True),
+    ("avg", 2, 2, 0, True, False),
+    ("max", 3, 3, 0, True, False),   # non-divisible stride + ceil
+    ("avg", 5, 3, 1, True, True),    # the reference pooling3D 2-D case
+]
+
+
+def _ref_pool(xv, ptype, k, s, p, ceil, excl, H, W):
+    extra = ([pool_extra_padding(H, k, p, s),
+              pool_extra_padding(W, k, p, s)] if ceil else [0, 0])
+    pad = ((0, 0), (0, 0), (p, p + extra[0]), (p, p + extra[1]))
+    if ptype == "max":
+        return lax.reduce_window(xv, -jnp.inf, lax.max, (1, 1, k, k),
+                                 (1, 1, s, s), pad)
+    sm = lax.reduce_window(xv, 0.0, lax.add, (1, 1, k, k), (1, 1, s, s), pad)
+    if excl:
+        cn = lax.reduce_window(jnp.ones_like(xv), 0.0, lax.add,
+                               (1, 1, k, k), (1, 1, s, s), pad)
+        return sm / cn
+    return sm / (k * k)
+
+
+@pytest.mark.parametrize("ptype,k,s,p,ceil,excl", CASES)
+def test_pool2d_forward_and_grad_match_reference(ptype, k, s, p, ceil, excl):
+    shape = (2, 3, 9, 9)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(*shape).astype("float32")
+    t = OpTest()
+    t.op_type = "pool2d"
+    attrs = {"pooling_type": ptype, "ksize": [k, k], "strides": [s, s],
+             "paddings": [p, p], "ceil_mode": ceil, "exclusive": excl,
+             "global_pooling": False}
+    out, g = t.build_and_run({"X": [("x", xs)]}, attrs, ["Out"],
+                             fetch_grads_for=["x"])
+    H, W = shape[2], shape[3]
+    ref = _ref_pool(jnp.asarray(xs), ptype, k, s, p, ceil, excl, H, W)
+    # ceil extent formula drives the actual output shape
+    oh = pool_out_extent(H, k, p, s, ceil)
+    ow = pool_out_extent(W, k, p, s, ceil)
+    assert np.asarray(out).shape == (2, 3, oh, ow)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+    gref = jax.grad(lambda v: jnp.mean(
+        _ref_pool(v, ptype, k, s, p, ceil, excl, H, W)))(jnp.asarray(xs))
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_v1_img_pool_defaults_are_ceil_and_exclusive():
+    """img_pool_layer defaults mirror the reference: ceil extents
+    (cnn_output_size caffe_mode=False) and exclude-mode averaging — a
+    7x7 image with 2x2/s2 pooling yields 4x4, and an avg pool at the
+    ragged edge divides by the REAL cell count, not k*k."""
+    import paddle_tpu as fluid
+    import paddle_tpu.executor as em
+    from paddle_tpu.trainer.config_parser import parse_config
+    from paddle_tpu.v2.topology import Topology
+
+    holder = {}
+
+    def config():
+        from paddle_tpu.trainer_config_helpers import (AvgPooling,
+                                                       data_layer,
+                                                       img_pool_layer,
+                                                       outputs)
+
+        img = data_layer(name="img", size=7 * 7, height=7, width=7)
+        pool = img_pool_layer(input=img, pool_size=2, stride=2,
+                              num_channels=1, pool_type=AvgPooling())
+        holder["pool"] = pool
+        outputs(pool)
+
+    conf = parse_config(config)
+    assert holder["pool"].size == 4 * 4  # ceil(7/2) = 4 per dim
+    topo = Topology(None, output_layers=[holder["pool"]])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = em.Scope()
+    xs = np.arange(49, dtype=np.float32).reshape(1, 49)
+    with em.scope_guard(scope):
+        exe.run(topo.startup_program)
+        (out,) = exe.run(topo.main_program, feed={"img": xs},
+                         fetch_list=[topo.output_vars[0]])
+    out = np.asarray(out).reshape(1, 1, 4, 4)
+    x = xs.reshape(7, 7)
+    # bottom-right corner window covers only cell (6,6): exclude-mode
+    # average = the cell itself, not cell/4
+    np.testing.assert_allclose(out[0, 0, 3, 3], x[6, 6], rtol=1e-6)
+    # interior window is the plain 2x2 mean
+    np.testing.assert_allclose(out[0, 0, 0, 0], x[0:2, 0:2].mean(),
+                               rtol=1e-6)
