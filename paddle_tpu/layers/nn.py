@@ -600,14 +600,17 @@ def gru_unit(input, hidden, size: int, param_attr=None, bias_attr=None,
     d = size // 3
     w = helper.create_parameter(param_attr, shape=[d, size],
                                 dtype=input.dtype)
-    b = helper.create_parameter(bias_attr, shape=[size], dtype=input.dtype,
-                                default_initializer=ConstantInitializer(0.0))
+    ins = {"Input": [input], "HiddenPrev": [hidden], "Weight": [w]}
+    if bias_attr is not False:  # False = no bias, the v1 idiom
+        b = helper.create_parameter(
+            bias_attr, shape=[size], dtype=input.dtype,
+            default_initializer=ConstantInitializer(0.0))
+        ins["Bias"] = [b]
     gate = helper.create_tmp_variable(input.dtype, (input.shape[0], size))
     rhp = helper.create_tmp_variable(input.dtype, (input.shape[0], d))
     out = helper.create_tmp_variable(input.dtype, (input.shape[0], d))
     helper.append_op(type="gru_unit",
-                     inputs={"Input": [input], "HiddenPrev": [hidden],
-                             "Weight": [w], "Bias": [b]},
+                     inputs=ins,
                      outputs={"Gate": [gate], "ResetHiddenPrev": [rhp],
                               "Hidden": [out]},
                      attrs={"activation": activation})

@@ -1234,8 +1234,12 @@ def memory(name, size, boot_layer=None, boot_with_const_value=None,
     lo._mem_link = name
 
     def set_input(layer):
-        # reference memory.set_input: late-bind the linked step layer
+        # reference memory.set_input: late-bind the linked step layer.
+        # The object reference also covers layers NOT reachable from
+        # the group outputs (e.g. the lstm cell companion, a consumer
+        # of the hidden rather than an ancestor).
         lo._mem_link = layer.name
+        lo._mem_link_layer = layer
 
     lo.set_input = set_input
     lo._mem_boot_const = boot_with_const_value
@@ -1287,6 +1291,28 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
     boot_parents = [m.parents[0] for m in memories if m.parents]
     parents = seq_ins + [s.input for s in static_ins] + boot_parents
     group_key = f"@group_{name or _v2._uname('rg')}"
+
+    # capture the group machinery the reference proto records — these
+    # are REAL objects of this group (step-input placeholders, memory
+    # links, the group itself), recorded with the reference's proto
+    # types (recurrent_layer_group / scatter_agent / agent; the
+    # step-layer entries recorded during step() already reference the
+    # placeholder/memory names, so the wiring lines up)
+    if _g_capture is not None:
+        layers_cap = _g_capture.setdefault("layers", [])
+        # the group's inputs are recorded for feed classification; the
+        # canonical protostr compare drops them on BOTH sides (the ref
+        # proto leaves them off the group node)
+        layers_cap.append({"name": group_key, "size": None,
+                           "type": "recurrent_layer_group",
+                           "inputs": [p.name for p in seq_ins]
+                           + [st.input.name for st in static_ins]})
+        for ph in placeholders + static_phs:
+            layers_cap.append({"name": ph.name, "type": "scatter_agent",
+                               "size": ph.size, "inputs": []})
+        for m in memories:
+            layers_cap.append({"name": m.name, "type": "agent",
+                               "size": m.size, "inputs": []})
 
     # -- scan-epilogue hoisting (TPU-first optimization) --------------
     # A step-output layer that no memory depends on is a pure map over
@@ -1402,7 +1428,8 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
                 out_vars.append(ov)
                 rnn.step_output(ov)
             for m, mv in zip(memories, mem_vars):
-                linked = by_name.get(m._mem_link)
+                linked = getattr(m, "_mem_link_layer", None) \
+                    or by_name.get(m._mem_link)
                 if linked is None:
                     raise KeyError(
                         f"memory(name={m._mem_link!r}) links to no layer "
@@ -1449,7 +1476,9 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
         lo = LayerOutput(name if (name and i == 0) else
                          _v2._uname("rg_out"), parents, build,
                          size=outs[i].size, is_seq=True)
-        group_outs.append(_record(lo, "recurrent_group"))
+        # the group output is the reference's gather_agent (proto
+        # carries no inputs on agents)
+        group_outs.append(_record(lo, "gather_agent", inputs=[]))
     return group_outs[0] if len(group_outs) == 1 else group_outs
 
 

@@ -887,35 +887,62 @@ def gru_step_layer(input, output_mem, size=None, act=None, name=None,
                                param_attr=param_attr, bias_attr=bias_attr)
         return out
 
-    return _simple("gru_step", [input, output_mem], build, size=h, name=name)
+    return _simple("gru_step", [input, output_mem], build, size=h, name=name,
+                   active_type=(act.name if act else "tanh"))
 
 
 gru_step_naive_layer = gru_step_layer
 
 
 def lstm_step_layer(input, state, size=None, act=None, name=None,
-                    gate_act=None, state_act=None, bias_attr=None, **kw):
+                    gate_act=None, state_act=None, bias_attr=None,
+                    with_state_output=False, **kw):
     """One LSTM step (reference LstmStepLayer): input = 4h gate
-    projection, state = previous cell.  Returns the new hidden."""
+    projection, state = previous cell.  Returns the new hidden; with
+    ``with_state_output`` also returns the new cell as a second
+    LayerOutput (the reference's get_output(lstm_step, 'state') —
+    lstmemory_group links its state memory to it)."""
     h = size or (input.size // 4 if input.size else None)
+    # per-build cell stash lives in the build ctx (dies with the
+    # Topology); the closure holds only this small key object
+    cell_key = ("lstm_step_cell", object())
 
     def build(ctx, x, c_prev):
-        out_c = _op("lstm_unit", {"X": [_unwrap(x)], "C_prev": [_unwrap(c_prev)]},
-                    {"forget_bias": 0.0}, out_slot="C")
-        # H shares the op instance in fluid.layers.lstm_unit; here re-run
-        # for the hidden slot via the helper layer
         from paddle_tpu.layer_helper import LayerHelper
 
-        helper = LayerHelper("lstm_step")
+        helper = LayerHelper("lstm_step", bias_attr=bias_attr)
+        gates = _unwrap(x)
+        if bias_attr is not False:
+            # trainable 4h gate bias (reference LstmStepLayer bias /
+            # the fused L.lstm bias this group form replaces)
+            b = helper.create_parameter(bias_attr, shape=[4 * h],
+                                        dtype="float32", is_bias=True)
+            from paddle_tpu import layers as L
+
+            gates = L.elementwise_add(gates, b)
         c = helper.create_tmp_variable("float32", None)
         hh = helper.create_tmp_variable("float32", None)
         helper.append_op(type="lstm_unit",
-                         inputs={"X": [_unwrap(x)], "C_prev": [_unwrap(c_prev)]},
+                         inputs={"X": [gates], "C_prev": [_unwrap(c_prev)]},
                          outputs={"C": [c], "H": [hh]},
                          attrs={"forget_bias": 0.0})
+        ctx[cell_key] = c
         return hh
 
-    return _simple("lstm_step", [input, state], build, size=h, name=name)
+    hid = _simple("lstm_step", [input, state], build, size=h, name=name,
+                  active_type=(act.name if act else "tanh"))
+
+    if not with_state_output:
+        return hid
+
+    def build_c(ctx, _h):
+        # parent dependency guarantees the step build already ran in
+        # this ctx and stashed the cell var
+        return ctx[cell_key]
+
+    cell = _simple("get_output", [hid], build_c, size=h,
+                   name=(name + "@state") if name else None)
+    return hid, cell
 
 
 # -- enums / markers (reference config constants) ----------------------------

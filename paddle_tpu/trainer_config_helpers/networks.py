@@ -94,12 +94,31 @@ def _as_mixed(lo):
     return lo
 
 
-def simple_gru(input, size, reverse=False, act=None, name=None, **kwargs):
+def simple_gru(input, size, reverse=False, act=None, name=None,
+               mixed_param_attr=None, mixed_bias_param_attr=None,
+               gru_param_attr=None, gru_bias_attr=None, **kwargs):
+    """mixed 3h transform + gru_group (the reference networks.py
+    simple_gru is the GROUP form; the fused form is what
+    bidirectional_gru uses)."""
     proj = _as_mixed(
         _l.fc_layer(input=input, size=size * 3, act=LinearActivation(),
+                    param_attr=mixed_param_attr,
+                    bias_attr=(mixed_bias_param_attr
+                               if mixed_bias_param_attr is not None
+                               else False),
                     name=name and name + "_proj"))
-    return _l.grumemory(input=proj, size=size, reverse=reverse, act=act,
-                        name=name)
+    return gru_group(input=proj, size=size, reverse=reverse, act=act,
+                     gru_param_attr=gru_param_attr,
+                     gru_bias_attr=gru_bias_attr, name=name)
+
+
+def _fused_gru(input, size, reverse=False, name=None):
+    """fc 3h + fused grumemory — the form the reference's
+    bidirectional_gru emits (gated_recurrent proto type)."""
+    proj = _as_mixed(
+        _l.fc_layer(input=input, size=size * 3, act=LinearActivation(),
+                    bias_attr=False, name=name and name + "_proj"))
+    return _l.grumemory(input=proj, size=size, reverse=reverse, name=name)
 
 
 def bidirectional_lstm(input, size, return_seq=False, name=None, **kwargs):
@@ -116,39 +135,75 @@ def bidirectional_lstm(input, size, return_seq=False, name=None, **kwargs):
 def lstmemory_group(input, size=None, name=None, reverse=False, act=None,
                     gate_act=None, state_act=None, memory_boot=None,
                     lstm_bias_attr=None, input_proj_bias_attr=None,
-                    input_proj_layer_attr=None, lstm_layer_attr=None,
-                    **kwargs):
-    """LSTM over a pre-projected (4*size) sequence input (reference
-    networks.py lstmemory_group — an explicit recurrent_group around
-    the lstm step; here the fused lstmemory layer computes the same
-    sequence of hidden states)."""
-    if memory_boot is not None:
-        raise NotImplementedError(
-            "lstmemory_group(memory_boot=...) boots from a layer; the "
-            "fused lstmemory path always boots from zeros")
+                    input_proj_layer_attr=None, param_attr=None,
+                    lstm_layer_attr=None, **kwargs):
+    """LSTM over a pre-projected (4*size) sequence input as an EXPLICIT
+    recurrent_group around the lstm step (reference networks.py
+    lstmemory_group: input_recurrent mixed = x_t + W_r . h_{t-1},
+    lstm_step over the previous cell, a get_output state link) —
+    structurally identical to the reference proto, computed as one
+    lax.scan."""
+    from paddle_tpu.trainer_config_helpers.layers_extra import \
+        lstm_step_layer
+
     ins = input[0] if isinstance(input, (list, tuple)) else input
-    return _l.lstmemory(input=ins, size=size, reverse=reverse, act=act,
-                        name=name)
+    h = size or (ins.size // 4 if ins.size else None)
+    gname = name or _l._v2._uname("lstm_group")
+
+    def step(x_t):
+        out_mem = _l.memory(name=gname + "@step", size=h,
+                            boot_layer=memory_boot)
+        state_mem = _l.memory(name=gname + "@state", size=h)
+        with _l.mixed_layer(size=4 * h,
+                            bias_attr=(input_proj_bias_attr
+                                       if input_proj_bias_attr is not None
+                                       else False)) as m:
+            m += _l.identity_projection(input=x_t)
+            m += _l.full_matrix_projection(input=out_mem,
+                                           param_attr=param_attr)
+        hid, cell = lstm_step_layer(
+            input=m._lo, state=state_mem, size=h, act=act,
+            gate_act=gate_act, state_act=state_act,
+            bias_attr=lstm_bias_attr, name=gname + "@step",
+            with_state_output=True)
+        state_mem.set_input(cell)
+        return hid
+
+    return _l.recurrent_group(step=step, input=[ins], reverse=reverse,
+                              name=gname)
 
 
 def gru_group(input, size=None, name=None, reverse=False, act=None,
               gate_act=None, memory_boot=None, gru_bias_attr=None,
-              gru_layer_attr=None, **kwargs):
-    """GRU over a pre-projected (3*size) sequence input (reference
-    networks.py gru_group)."""
-    if memory_boot is not None:
-        raise NotImplementedError(
-            "gru_group(memory_boot=...) boots from a layer; the fused "
-            "grumemory path always boots from zeros")
+              gru_param_attr=None, gru_layer_attr=None, **kwargs):
+    """GRU over a pre-projected (3*size) sequence input as an EXPLICIT
+    recurrent_group whose step is gru_step_layer (reference
+    networks.py gru_group) — the group structure the reference proto
+    records, computed as one lax.scan."""
+    from paddle_tpu.trainer_config_helpers.layers_extra import \
+        gru_step_layer
+
     ins = input[0] if isinstance(input, (list, tuple)) else input
-    return _l.grumemory(input=ins, size=size, reverse=reverse, act=act,
-                        name=name, bias_attr=gru_bias_attr)
+    h = size or (ins.size // 3 if ins.size else None)
+    gname = name or _l._v2._uname("gru_group")
+
+    def step(x_t):
+        mem = _l.memory(name=gname + "@step", size=h,
+                        boot_layer=memory_boot)
+        return gru_step_layer(input=x_t, output_mem=mem, size=h, act=act,
+                              gate_act=gate_act,
+                              param_attr=gru_param_attr,
+                              bias_attr=gru_bias_attr,
+                              name=gname + "@step")
+
+    return _l.recurrent_group(step=step, input=[ins], reverse=reverse,
+                              name=gname)
 
 
 def bidirectional_gru(input, size, return_seq=False, name=None, **kwargs):
-    fwd = simple_gru(input=input, size=size, reverse=False,
+    fwd = _fused_gru(input=input, size=size, reverse=False,
                      name=name and name + "_fw")
-    bwd = simple_gru(input=input, size=size, reverse=True,
+    bwd = _fused_gru(input=input, size=size, reverse=True,
                      name=name and name + "_bw")
     if return_seq:
         return _l.concat_layer(input=[fwd, bwd], name=name)

@@ -150,8 +150,9 @@ REF_TYPE_MAP = {"selective_fc": "fc"}
 # parents: batch_norm carries its running-stat aggregates as 2 extra
 # inputs (proto layers{} inputs repeated 3x); selective_fc carries the
 # selection mask.
-REF_DROP_INPUTS = {"batch_norm": 1, "selective_fc": 1}
-OUR_DROP_INPUTS = {"batch_norm": 1}
+REF_DROP_INPUTS = {"batch_norm": 1, "selective_fc": 1,
+                   "recurrent_layer_group": 0}
+OUR_DROP_INPUTS = {"batch_norm": 1, "recurrent_layer_group": 0}
 
 # Our mixed-layer *operators* (dotmul_operator / conv_operator) are
 # standalone capture nodes feeding the mixed; the reference folds their

@@ -90,7 +90,7 @@ SEQ_CONSUMERS = {
     "seqlastins", "seqfirstins", "seq_pool", "pooling", "seq_concat",
     "seq_reshape", "seq_slice", "kmax_seq_score", "sub_seq",
     "sub_nested_seq", "expand", "lstmemory", "grumemory", "recurrent",
-    "recurrent_group",
+    "recurrent_layer_group",
     "row_conv", "ctc", "warp_ctc", "gated_recurrent", "seq_last",
     "seq_first", "max_id_seq", "crf", "seqtext_printer",
 }
@@ -265,26 +265,13 @@ def _load_goldens():
     return {}
 
 
-# Configs whose reference golden encodes the recurrent_layer_group
-# machinery (scatter/gather agents, per-step sub-model layers) that this
-# framework deliberately redesigns into fused lax.scan-backed layers
-# (PARITY.md; paddle_tpu/v2/layer.py lstmemory/gru,
-# paddle_tpu/trainer_config_helpers/layers.py recurrent_group).  For
-# these, test_matches_reference_protostr asserts the weaker
-# recurrence-site invariant instead of full canonical equality.
-PROTOSTR_REDESIGNED = {
-    "shared_gru.py":
-        "reference simple_gru = gru_group (recurrent_layer_group with "
-        "scatter/gather agents + gru_step); ours = mixed transform + "
-        "fused gated_recurrent (lax.scan)",
-    "shared_lstm.py":
-        "reference lstmemory_group machinery; ours = mixed transform + "
-        "fused lstmemory (lax.scan)",
-    "test_rnn_group.py":
-        "reference emits one sub-model per recurrent_group with "
-        "agents; ours emits a recurrent_group node wrapping the "
-        "scanned step (tests/test_recurrent_group.py covers numerics)",
-}
+# Round-5 close: recurrent_group now captures its REAL machinery
+# (step-input placeholders as scatter_agents, memory links as agents,
+# the group node, gather_agent outputs) and gru_group/lstmemory_group
+# are explicit groups like the reference's, so ALL 56 configs compare
+# exactly and this table is empty.  Kept for any future deliberate
+# redesign (entries get the weaker recurrence-site check below).
+PROTOSTR_REDESIGNED = {}
 
 # ref group-machinery types that mark one recurrence site
 _REF_RECURRENCE_TYPES = {"recurrent_layer_group"}

@@ -42,10 +42,12 @@ def _infer_with_shared_params(build_a, build_b, rows, rtol=1e-5):
     for na, nb in zip(names_a, names_b):
         wa = all_params[0][1].get(na)
         wb = all_params[1][1].get(nb)
-        assert wa.shape == wb.shape, (na, wa.shape, nb, wb.shape)
-        w = rng.uniform(-0.5, 0.5, wa.shape).astype(np.float32)
-        all_params[0][1].set(na, w)
-        all_params[1][1].set(nb, w)
+        # same numel, layout may differ (e.g. fused gru bias (1, 3h)
+        # vs gru_unit bias (3h,))
+        assert wa.size == wb.size, (na, wa.shape, nb, wb.shape)
+        w = rng.uniform(-0.5, 0.5, wa.size).astype(np.float32)
+        all_params[0][1].set(na, w.reshape(wa.shape))
+        all_params[1][1].set(nb, w.reshape(wb.shape))
     for out_layer, params in all_params:
         outs.append(np.asarray(paddle.infer(output_layer=out_layer,
                                             parameters=params, input=rows)))
@@ -184,3 +186,35 @@ def test_gated_unit_equals_manual_gate():
         return LayerOutput("manual_gate", [proj, gate], build, size=3)
 
     _infer_with_shared_params(via_gated, via_manual, _x())
+
+
+def test_gru_group_equals_fused_grumemory():
+    """The explicit recurrent_group GRU (reference gru_group form, what
+    simple_gru builds) computes the SAME sequence as the fused
+    grumemory lax.scan kernel given equal parameters — the
+    group-vs-fused cross-check test_CompareTwoNets ran for the
+    reference's two RNN machines."""
+    from paddle_tpu.trainer_config_helpers import layers as v1
+    from paddle_tpu.trainer_config_helpers.networks import gru_group
+    from paddle_tpu.v2.data_type import dense_vector_sequence
+
+    rng = np.random.RandomState(3)
+    rows = [(rng.randn(int(rng.randint(2, 6)), 12).astype(np.float32),)
+            for _ in range(3)]
+
+    def seq_data():
+        x = v1.data_layer(name="x", size=12)
+        x.input_type = dense_vector_sequence(12)
+        return x
+
+    def via_group():
+        x = seq_data()
+        g = gru_group(input=x, size=4)
+        return v1.last_seq(input=g)
+
+    def via_fused():
+        x = seq_data()
+        g = v1.grumemory(input=x, size=4)
+        return v1.last_seq(input=g)
+
+    _infer_with_shared_params(via_group, via_fused, rows)
