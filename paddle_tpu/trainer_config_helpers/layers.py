@@ -1340,7 +1340,8 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
             _mark_needed(p)
 
     for m in memories:
-        linked = by_name.get(m._mem_link)
+        linked = (getattr(m, "_mem_link_layer", None)
+                  or by_name.get(m._mem_link))
         if linked is not None:
             _mark_needed(linked)
         mem_needed.add(id(m))
