@@ -85,13 +85,16 @@ def test_column_sum_evaluator_value():
         column_sum_evaluator
 
     feed = _feed(seed=1)
-    outs = _run_with_evaluator(
-        lambda pred, lab: column_sum_evaluator(input=pred), feed)
-    # fetch pred to compute the expected last-column mean
+
+    # expose pred as a second extra output so the expected last-column
+    # mean is computed from the SAME forward
+    def make(pred, lab):
+        return [column_sum_evaluator(input=pred), pred]
+
+    outs = _run_with_evaluator(make, feed)
     got = float(np.asarray(outs[1]).reshape(()))
-    assert 0.0 < got < 1.0  # mean of a softmax column
-    # cross-check numerically via an identical run fetching nothing extra
-    assert np.isfinite(got)
+    pred_vals = np.asarray(outs[2])
+    np.testing.assert_allclose(got, pred_vals[:, -1].mean(), rtol=1e-5)
 
 
 def test_value_printer_prints(capfd):
