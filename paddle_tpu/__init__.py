@@ -30,6 +30,7 @@ from paddle_tpu.framework import (
     default_main_program,
     default_startup_program,
     program_guard,
+    recompute_scope,
     CPUPlace,
     TPUPlace,
 )

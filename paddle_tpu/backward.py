@@ -15,7 +15,7 @@ the forward pass, so replayed subexpressions CSE away.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 from paddle_tpu import framework
 from paddle_tpu.framework import (
@@ -99,6 +99,58 @@ def _make_grad_op_desc(
     return (op.type + "_grad", inputs, outputs, attrs)
 
 
+def _append_segment_grad(block, seg_id, fwd_ops, no_grad, _settle,
+                         _contribute, pending):
+    """One grad op for a whole rematerialization segment (forward-order
+    ``fwd_ops``): inputs are the segment's external activations/params
+    plus the settled grads of its externally-consumed outputs; outputs
+    are grads of every differentiable external input."""
+    produced: Set[str] = set()
+    ext_in: List[str] = []
+    for op in fwd_ops:
+        for ns in op.inputs.values():
+            for n in ns:
+                if n and n not in produced and n not in ext_in:
+                    ext_in.append(n)
+        for ns in op.outputs.values():
+            produced.update(n for n in ns if n)
+
+    # externally-consumed outputs = those with grad contributions from
+    # already-processed (later) consumers
+    ext_out = [n for n in sorted(produced) if pending.get(n)]
+    if not ext_out:
+        return
+    gout_names = []
+    for n in ext_out:
+        g = _settle(n)
+        gout_names.append(g if g is not None else "")
+
+    gin_names = []
+    for n in ext_in:
+        if _wants_grad(block, n, no_grad):
+            gn = grad_var_name(n)
+            if pending.get(n):
+                gn = unique_name(gn + "@RENAME")
+            _ensure_grad_var(block, n, gn)
+            gin_names.append(gn)
+            _contribute(n, gn)
+        else:
+            gin_names.append("")
+
+    key_name = f"__segkey_{seg_id}__"
+    ins = {"X": list(ext_in), "OutGrad": gout_names}
+    if block.find_var(key_name) is not None:
+        ins["SegKey"] = [key_name]
+    block.append_op(
+        type="recompute_segment_grad",
+        inputs=ins,
+        outputs={"X@GRAD": gin_names},
+        attrs={"__seg_ops__": list(fwd_ops),
+               "__seg_inputs__": list(ext_in),
+               "__seg_outputs__": list(ext_out),
+               "__seg_id__": seg_id})
+
+
 def append_backward(
     loss: Variable,
     parameter_list: Optional[Sequence[str]] = None,
@@ -165,7 +217,34 @@ def append_backward(
     def _contribute(name: str, grad_name: str):
         pending.setdefault(name, []).append(grad_name)
 
-    for op in relevant_ops:
+    # group consecutive relevant ops that share a rematerialization
+    # segment (fluid.recompute_scope): one recompute_segment_grad op
+    # replaces their per-op grads — it re-derives the forward from the
+    # segment's external inputs inside its own vjp, so intermediates
+    # are never saved across forward->backward
+    grouped: List[Any] = []
+    for op in relevant_ops:  # already reverse order
+        seg = op.attr("__recompute_seg__", None)
+        if seg is not None and grouped and grouped[-1][0] == seg:
+            grouped[-1][1].append(op)
+        elif seg is not None:
+            grouped.append((seg, [op]))
+        else:
+            grouped.append((None, [op]))
+
+    flat: List[Any] = []
+    for seg, seg_rev_ops in grouped:
+        if seg is None:
+            flat.extend(("op", o) for o in seg_rev_ops)
+        else:
+            flat.append(("seg", seg, list(reversed(seg_rev_ops))))
+
+    for item in flat:
+        if item[0] == "seg":
+            _append_segment_grad(block, item[1], item[2], no_grad,
+                                 _settle, _contribute, pending)
+            continue
+        op = item[1]
         desc = _make_grad_op_desc(op, block, no_grad)
         if desc is None:
             continue

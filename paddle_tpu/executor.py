@@ -178,9 +178,19 @@ class _Compiled:
         self.uses_rng = uses_rng
 
 
+def _segment_op_rng(seg_key, op):
+    """Deterministic per-op RNG inside a rematerialization segment:
+    fold the op's stable __seg_rng_idx__ into the segment key, so the
+    forward pass and the (possibly pruned) backward replay derive
+    IDENTICAL keys for each random op regardless of which segment ops
+    the replay runs."""
+    idx = op.attr("__seg_rng_idx__", 0)
+    return RngState(jax.random.fold_in(seg_key, idx))
+
+
 _RANDOM_OPS = frozenset(
     {"uniform_random", "gaussian_random", "dropout", "sampling_id",
-     "random_crop", "nce"}
+     "random_crop", "nce", "segment_rng_key"}
 )
 
 
@@ -377,6 +387,40 @@ class Executor:
 
         strategy = self.strategy
 
+        # Rematerialization segments (fluid.recompute_scope): group
+        # consecutive forward ops sharing a __recompute_seg__ id.  A
+        # segment's intermediates stay LOCAL — only values consumed by
+        # later ops / fetches / state leave it — and its matching
+        # recompute_segment_grad op (backward.py) re-derives the
+        # forward from the segment inputs inside its own vjp, so the
+        # intermediates are never live across the fwd->bwd span: the
+        # activation-memory/FLOPs trade jax.checkpoint makes, expressed
+        # at the program level where this framework's AD lives.
+        op_groups: List[Any] = []
+        for op in ops:
+            seg = op.attr("__recompute_seg__", None)
+            if op_groups and op_groups[-1][0] == seg:
+                op_groups[-1][1].append(op)
+            else:
+                op_groups.append((seg, [op]))
+
+        # per segment: names its later consumers need (externally
+        # visible); everything else is segment-local.  One reverse
+        # suffix pass keeps this O(N) for many segments.
+        seg_exports: Dict[int, tuple] = {}
+        suffix_reads = set(fetch_names) | set(out_state_names)
+        for seg, seg_ops in reversed(op_groups):
+            if seg is not None:
+                written = set()
+                for op in seg_ops:
+                    for ns in op.outputs.values():
+                        written.update(n for n in ns if n)
+                seg_exports[id(seg_ops[0])] = tuple(
+                    sorted(written & suffix_reads))
+            for op in seg_ops:
+                for ns in op.inputs.values():
+                    suffix_reads.update(n for n in ns if n)
+
         def run_block(state, feeds, seed=None):
             from paddle_tpu.parallel.strategy import strategy_scope
 
@@ -385,10 +429,29 @@ class Executor:
             values.update(feeds)
             rng = RngState(jax.random.key(seed)) if seed is not None else None
             with strategy_scope(strategy):
-                for op in ops:
-                    info = OpRegistry.get(op.type)
-                    info.lower(LowerContext(op, values, rng=rng,
-                                            executor_ctx=program))
+                for seg, seg_ops in op_groups:
+                    if seg is None:
+                        for op in seg_ops:
+                            info = OpRegistry.get(op.type)
+                            info.lower(LowerContext(op, values, rng=rng,
+                                                    executor_ctx=program))
+                        continue
+                    # the segment's randomness comes from its key op's
+                    # output (shared with the backward recompute)
+                    seg_key = values.get(f"__segkey_{seg}__")
+                    local = dict(values)
+                    for op in seg_ops:
+                        info = OpRegistry.get(op.type)
+                        # per-op key folded from the segment key and the
+                        # op's stable index (no key value — e.g. startup
+                        # init ops created inside the scope — falls back
+                        # to the plain outer rng)
+                        op_rng = (_segment_op_rng(seg_key, op)
+                                  if seg_key is not None else rng)
+                        info.lower(LowerContext(op, local, rng=op_rng,
+                                                executor_ctx=program))
+                    for n in seg_exports[id(seg_ops[0])]:
+                        values[n] = local[n]
             fetches = [values[n] for n in fetch_names]
             new_state = {n: values[n] for n in out_state_names}
             return fetches, new_state

@@ -301,6 +301,14 @@ class Operator:
             k: _as_name_list(v) for k, v in (outputs or {}).items()
         }
         self._attrs: Dict[str, Any] = _AttrDict(self, attrs or {})
+        if _RECOMPUTE_SEG[0] is not None:
+            self._attrs["__recompute_seg__"] = _RECOMPUTE_SEG[0]
+            # stable per-op key index: the backward replay may run a
+            # PRUNED subset of the segment (loss-relevant ops only), so
+            # positional key splitting would shift the stream — each
+            # op folds its own fixed index into the segment key instead
+            _RECOMPUTE_OP_IDX[0] += 1
+            self._attrs["__seg_rng_idx__"] = _RECOMPUTE_OP_IDX[0]
         # Run registry-side checks/infer-shape at append time, like the
         # reference's compile-time InferShape (framework/op_desc.cc).
         from paddle_tpu import registry
@@ -349,6 +357,11 @@ class Operator:
                 return {"__block__": v.idx}
             if isinstance(v, np.ndarray):
                 return {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
+            if (isinstance(v, list) and v
+                    and all(isinstance(o, Operator) for o in v)):
+                # recompute_segment_grad __seg_ops__: one-way dump
+                # (backward ops are pruned from inference exports)
+                return {"__seg_ops__": [o.to_dict() for o in v]}
             return v
 
         return {
@@ -616,6 +629,48 @@ def switch_startup_program(p: Program) -> Program:
     global _startup_program
     old, _startup_program = _startup_program, p
     return old
+
+
+_RECOMPUTE_SEG = [None]
+_RECOMPUTE_COUNTER = [0]
+_RECOMPUTE_OP_IDX = [0]
+
+
+@contextlib.contextmanager
+def recompute_scope():
+    """Mark every op appended inside this scope as one rematerialization
+    segment: the executor wraps the segment in ``jax.checkpoint`` so its
+    activations are NOT saved for backward — they recompute from the
+    segment inputs during the gradient pass, trading MXU FLOPs for HBM
+    (the standard TPU memory/compute trade the reference era solved
+    with smaller batches).  Random ops inside the segment replay
+    deterministically (the segment derives its keys from one captured
+    sub-key).  Host-side side effects (print/save ops) inside the scope
+    fire again during recompute — keep them outside.
+
+    Usage::
+
+        with fluid.recompute_scope():
+            h = fluid.layers.fc(h, 4096, act="relu")
+            h = fluid.layers.fc(h, 4096, act="relu")
+    """
+    _RECOMPUTE_COUNTER[0] += 1
+    seg = _RECOMPUTE_COUNTER[0]
+    prev = _RECOMPUTE_SEG[0]
+    # the segment key op runs OUTSIDE the segment: forward and the
+    # backward recompute both derive their randomness from its output,
+    # so dropout masks replay identically
+    blk = default_main_program().global_block()
+    key_name = f"__segkey_{seg}__"
+    blk.create_var(name=key_name, shape=(), dtype="int32",
+                   stop_gradient=True)
+    blk.append_op(type="segment_rng_key", outputs={"Out": [key_name]},
+                  attrs={"__seg_id__": seg})
+    _RECOMPUTE_SEG[0] = seg
+    try:
+        yield
+    finally:
+        _RECOMPUTE_SEG[0] = prev
 
 
 @contextlib.contextmanager

@@ -231,3 +231,60 @@ def _seq_text_printer(ctx):
     io_callback(host_write, jnp.zeros((), jnp.int32),
                 data.astype(jnp.int64), lengths, ids_val, ordered=True)
     ctx.set_output("Out", ctx.input("X"))
+
+
+@register_op("segment_rng_key", inputs=(), stop_gradient=True)
+def _segment_rng_key(ctx):
+    """PRNG key for one rematerialization segment
+    (fluid.recompute_scope): the forward segment AND its backward
+    recompute both derive randomness from this single value, so
+    dropout masks replay identically across the recompute."""
+    ctx.set_output("Out", ctx.rng())
+
+
+@register_op("recompute_segment_grad", inputs=("X", "OutGrad", "SegKey"),
+             stop_gradient=True)
+def _recompute_segment_grad(ctx):
+    """Backward of a rematerialization segment: re-derive the
+    segment's forward from its external inputs (instead of reading
+    saved intermediates) and apply jax.vjp — activations inside the
+    segment are never live across the forward->backward span, the
+    jax.checkpoint memory/FLOPs trade expressed at the program level
+    where this framework's per-op AD lives."""
+    from paddle_tpu.registry import RngState
+
+    seg_ops = ctx.attr("__seg_ops__")
+    ext_in = list(ctx.attr("__seg_inputs__"))
+    ext_out = list(ctx.attr("__seg_outputs__"))
+    key_names = ctx.op.inputs.get("SegKey") or []
+    key = (ctx.values.get(key_names[0]) if key_names else None)
+
+    def fwd(*in_vals):
+        local = dict(zip(ext_in, in_vals))
+        from paddle_tpu.executor import _segment_op_rng
+        from paddle_tpu.registry import LowerContext, OpRegistry
+
+        for op in seg_ops:
+            # per-op folded key: identical to the forward pass even
+            # though this replay may run a pruned (loss-relevant-only)
+            # subset of the segment
+            op_rng = (_segment_op_rng(key, op) if key is not None
+                      else None)
+            OpRegistry.get(op.type).lower(
+                LowerContext(op, local, rng=op_rng,
+                             executor_ctx=ctx.executor_ctx))
+        return tuple(local[n] for n in ext_out)
+
+    primals = tuple(ctx.values[n] for n in ext_in)
+    outs, vjp = jax.vjp(fwd, *primals)
+    gnames = ctx.op.inputs.get("OutGrad") or []
+    cts = []
+    for o, gn in zip(outs, gnames):
+        if gn and gn in ctx.values:
+            cts.append(ctx.values[gn])
+        else:
+            cts.append(jax.tree_util.tree_map(jnp.zeros_like, o))
+    gins = vjp(tuple(cts))
+    for name, g in zip(ctx.op.outputs.get("X@GRAD", []), gins):
+        if name:
+            ctx.values[name] = g
