@@ -21,11 +21,15 @@ from paddle_tpu.param_attr import ParamAttr
 def transformer_lm(tokens, vocab_size: int, d_model: int = 256,
                    num_heads: int = 8, num_layers: int = 2,
                    ffn_mult: int = 4, seq_len: int = None,
-                   tp_axis: str = None, causal: bool = True):
+                   tp_axis: str = None, causal: bool = True,
+                   recompute: bool = False, _head: bool = True):
     """tokens: (B, S, 1) int64 -> logits (B*S, vocab_size).
 
     ``tp_axis``: mesh axis name for Megatron TP sharding hints (ignored
-    when running unsharded).
+    when running unsharded).  ``recompute``: wrap each transformer
+    block in ``fluid.recompute_scope()`` so its activations
+    rematerialize in backward — the standard trade that lets batches
+    past the HBM activation limit train.
     """
     S = int(tokens.shape[1]) if seq_len is None else seq_len
     x = layers.embedding(
@@ -43,22 +47,31 @@ def transformer_lm(tokens, vocab_size: int, d_model: int = 256,
         shape=[S, d_model], dtype=x.dtype)
     x = elementwise_add(x, pos, axis=1)
 
-    for i in range(num_layers):
-        ln1 = layers.layer_norm(x, begin_norm_axis=2, name=f"ln1_{i}")
-        att = layers.multi_head_attention(
-            ln1, num_heads=num_heads, causal=causal, tp_axis=tp_axis,
-            name=f"attn_{i}")
-        res1 = elementwise_add(x, att)
-        ln2 = layers.layer_norm(res1, begin_norm_axis=2, name=f"ln2_{i}")
-        ff1 = layers.fc(ln2, d_model * ffn_mult, num_flatten_dims=2,
-                        act="relu", name=f"ffn1_{i}",
-                        param_attr=ParamAttr(shard=(None, tp_axis))
-                        if tp_axis else None)
-        ff2 = layers.fc(ff1, d_model, num_flatten_dims=2, name=f"ffn2_{i}",
-                        param_attr=ParamAttr(shard=(tp_axis, None))
-                        if tp_axis else None)
-        x = elementwise_add(res1, ff2)
+    import contextlib
 
+    from paddle_tpu.framework import recompute_scope
+
+    for i in range(num_layers):
+        with (recompute_scope() if recompute else contextlib.nullcontext()):
+            ln1 = layers.layer_norm(x, begin_norm_axis=2, name=f"ln1_{i}")
+            att = layers.multi_head_attention(
+                ln1, num_heads=num_heads, causal=causal, tp_axis=tp_axis,
+                name=f"attn_{i}")
+            res1 = elementwise_add(x, att)
+            ln2 = layers.layer_norm(res1, begin_norm_axis=2,
+                                    name=f"ln2_{i}")
+            ff1 = layers.fc(ln2, d_model * ffn_mult, num_flatten_dims=2,
+                            act="relu", name=f"ffn1_{i}",
+                            param_attr=ParamAttr(shard=(None, tp_axis))
+                            if tp_axis else None)
+            ff2 = layers.fc(ff1, d_model, num_flatten_dims=2,
+                            name=f"ffn2_{i}",
+                            param_attr=ParamAttr(shard=(tp_axis, None))
+                            if tp_axis else None)
+            x = elementwise_add(res1, ff2)
+
+    if not _head:
+        return x  # (B, S, d_model) hidden; caller builds the head
     x = layers.layer_norm(x, begin_norm_axis=2, name="ln_f")
     from paddle_tpu.layers.tensor import reshape
 
@@ -128,10 +141,34 @@ def transformer_lm_pipelined(tokens, vocab_size: int, d_model: int = 256,
 
 
 def transformer_lm_loss(tokens, labels, **kw):
-    """labels: (B, S, 1) int64; returns scalar mean loss."""
-    logits = transformer_lm(tokens, **kw)
+    """labels: (B, S, 1) int64; returns scalar mean loss.  With
+    ``recompute=True`` the whole LM head — ln_f, the lm_head
+    projection, softmax-CE — lives in ONE rematerialization segment,
+    so only the (B*S, d_model) hidden crosses the segment boundary:
+    at B*S x V the logits/softmax pair is the single largest
+    activation of the model (4+ GB at the bench shapes) and is never
+    saved across forward->backward."""
+    import contextlib
+
+    from paddle_tpu.framework import recompute_scope
     from paddle_tpu.layers.tensor import reshape
 
-    flat_labels = reshape(labels, shape=[-1, 1])
-    loss = layers.softmax_with_cross_entropy(logits, flat_labels)
-    return layers.mean(loss)
+    recompute = kw.get("recompute", False)
+    if not recompute:
+        logits = transformer_lm(tokens, **kw)
+        flat_labels = reshape(labels, shape=[-1, 1])
+        loss = layers.softmax_with_cross_entropy(logits, flat_labels)
+        return layers.mean(loss)
+    hidden = transformer_lm(tokens, _head=False, **kw)
+    d_model = kw.get("d_model", 256)
+    vocab_size = kw["vocab_size"]
+    tp_axis = kw.get("tp_axis")
+    with recompute_scope():
+        x = layers.layer_norm(hidden, begin_norm_axis=2, name="ln_f")
+        flat = reshape(x, shape=[-1, d_model])
+        logits = layers.fc(flat, vocab_size, name="lm_head",
+                           param_attr=ParamAttr(shard=(None, tp_axis))
+                           if tp_axis else None, bias_attr=False)
+        flat_labels = reshape(labels, shape=[-1, 1])
+        loss = layers.softmax_with_cross_entropy(logits, flat_labels)
+        return layers.mean(loss)
