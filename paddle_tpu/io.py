@@ -113,6 +113,17 @@ def load_persistables(executor, dirname, main_program=None):
     load_vars(executor, dirname, main_program, predicate=_is_persistable)
 
 
+def get_inference_program(target_vars, main_program: Optional[Program] = None) -> Program:
+    """Prune the program to the given targets and flip it to inference
+    mode (reference: fluid/io.py:154 get_inference_program =
+    ``prune(targets)`` + ``inference_optimize()``; here the test flip is
+    ``clone(for_test=True)``, which also strips training-only ops)."""
+    main_program = main_program or framework.default_main_program()
+    if not isinstance(target_vars, (list, tuple)):
+        target_vars = [target_vars]
+    return main_program.clone(for_test=True).prune(list(target_vars))
+
+
 def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
                          target_vars: Sequence[Variable], executor,
                          main_program: Optional[Program] = None):
@@ -120,7 +131,7 @@ def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
     (reference: fluid/io.py:165 + framework/prune.cc)."""
     main_program = main_program or framework.default_main_program()
     os.makedirs(dirname, exist_ok=True)
-    inference_program = main_program.clone(for_test=True).prune(list(target_vars))
+    inference_program = get_inference_program(list(target_vars), main_program)
     with open(os.path.join(dirname, "__model__.json"), "w") as f:
         json.dump({
             "program": inference_program.to_dict(),

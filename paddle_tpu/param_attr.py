@@ -38,6 +38,12 @@ class ParamAttr:
 
         if isinstance(arg, Initializer):
             return ParamAttr(initializer=arg)
+        from paddle_tpu.regularizer import WeightDecayRegularizer
+
+        if isinstance(arg, WeightDecayRegularizer):
+            # reference param_attr.py:47 — a bare regularizer means
+            # "default attrs + this weight decay"
+            return ParamAttr(regularizer=arg)
         if arg is True:
             # v1 bias_attr=True means "use a default bias"
             return ParamAttr()
