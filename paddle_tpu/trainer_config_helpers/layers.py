@@ -65,14 +65,16 @@ def _end_capture():
 
 
 def _record(lo: LayerOutput, type_: str, **cfg):
+    entry = {"name": lo.name, "type": type_, "size": lo.size,
+             "inputs": [p.name for p in lo.parents]}
+    entry.update(cfg)
+    # always attached, so v2 parse_network can reconstruct structure for
+    # layers built outside a capture; owners may amend their own entry
+    # later (pad geometry, network helpers retyping a transform) without
+    # name-keyed scans
+    lo._cfg_entry = entry
     if _g_capture is not None:
-        entry = {"name": lo.name, "type": type_, "size": lo.size,
-                 "inputs": [p.name for p in lo.parents]}
-        entry.update(cfg)
         _g_capture.setdefault("layers", []).append(entry)
-        # owners may amend their own entry later (pad geometry, network
-        # helpers retyping a transform) without name-keyed scans
-        lo._cfg_entry = entry
     return lo
 
 

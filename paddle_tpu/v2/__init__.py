@@ -29,6 +29,22 @@ from paddle_tpu.v2 import trainer
 from paddle_tpu.v2.inference import infer
 from paddle_tpu.v2.minibatch import batch
 
+
+def __getattr__(name):
+    # evaluator/op/data_feeder/config_base re-enter
+    # trainer_config_helpers, whose activations module imports this
+    # package — loading them lazily keeps the import graph acyclic
+    # (reference surface: python/paddle/v2/{evaluator,op,data_feeder,
+    # config_base}.py)
+    if name in ("evaluator", "op", "data_feeder", "config_base"):
+        import importlib
+
+        mod = importlib.import_module(f"paddle_tpu.v2.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(
+        f"module 'paddle_tpu.v2' has no attribute {name!r}")
+
 _initialized = False
 
 

@@ -1,0 +1,21 @@
+"""Compat shim (reference: python/paddle/v2/config_base.py).  The
+reference's ``Layer`` base re-wrapped v1 constructors into lazy v2
+objects via ``__convert_to_v2__``; in this repo v1 and v2 share one
+lazy LayerOutput class (paddle_tpu/v2/layer.py), so ``Layer`` IS
+LayerOutput (resolved lazily — layer.py may still be mid-import when
+this module loads) and the converter is the identity."""
+
+__all__ = ["Layer", "__convert_to_v2__"]
+
+
+def __getattr__(name):
+    if name == "Layer":
+        from paddle_tpu.v2.layer import LayerOutput
+
+        return LayerOutput
+    raise AttributeError(
+        f"module 'paddle_tpu.v2.config_base' has no attribute {name!r}")
+
+
+def __convert_to_v2__(f, name=None, module=None):
+    return f

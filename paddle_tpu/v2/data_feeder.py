@@ -1,0 +1,15 @@
+"""v2 DataFeeder module surface (reference:
+python/paddle/v2/data_feeder.py — the DataProviderConverter facade
+taking ``data_types`` [(name, InputType)...] and an optional
+``feeding`` name→column map).  Conversion itself is the TPU padded
+dense layout of V2DataFeeder (v2/trainer.py): sequences become
+(B, T, ...) arrays plus ``<name>@len`` vectors."""
+
+from paddle_tpu.v2.trainer import V2DataFeeder
+
+__all__ = ["DataFeeder"]
+
+
+class DataFeeder(V2DataFeeder):
+    def __init__(self, data_types, feeding=None, **kwargs):
+        super().__init__(data_types, feeding, **kwargs)

@@ -1,0 +1,17 @@
+"""v2 evaluator facade (reference: python/paddle/v2/evaluator.py —
+every trainer_config_helpers ``*_evaluator`` exposed under v2 with the
+suffix stripped, e.g. ``paddle.v2.evaluator.auc``).  The v1 evaluator
+constructors already return lazy LayerOutput metric nodes on the shared
+TPU Program path, so the facade is pure renaming."""
+
+import paddle_tpu.trainer_config_helpers.evaluators as _evs
+
+__all__ = []
+
+for _name in _evs.__all__:
+    if _name.endswith("_evaluator"):
+        _new = _name[:-len("_evaluator")]
+        globals()[_new] = getattr(_evs, _name)
+        __all__.append(_new)
+
+del _name, _new

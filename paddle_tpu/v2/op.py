@@ -1,0 +1,14 @@
+"""v2 math-op surface (reference: python/paddle/v2/op.py — unary math
+ops as one-projection mixed layers, plus +/-/* operator overloads on
+the Layer class).  The repo's v1 and v2 share one LayerOutput class, so
+the overloads install once via trainer_config_helpers.layer_math and
+this module re-exports the unary functions under v2."""
+
+from paddle_tpu.trainer_config_helpers import layer_math as _m
+
+__all__ = list(_m.__all__)
+
+for _name in __all__:
+    globals()[_name] = getattr(_m, _name)
+
+del _name
