@@ -16,6 +16,21 @@ from paddle_tpu.framework import TPUPlace
 from paddle_tpu.v2.topology import Topology
 
 
+def write_npy_tar(named_arrays, f):
+    """Write {name: array} pairs in the Parameters tar layout (one
+    ``<name>.npy`` member per parameter) — the single definition of the
+    format, shared with utils.torch2paddle."""
+    with tarfile.open(fileobj=f, mode="w") as tar:
+        for name, arr in named_arrays:
+            buf = _io.BytesIO()
+            np.save(buf, np.ascontiguousarray(np.asarray(arr)),
+                    allow_pickle=False)
+            data = buf.getvalue()
+            info = tarfile.TarInfo(name=name + ".npy")
+            info.size = len(data)
+            tar.addfile(info, _io.BytesIO(data))
+
+
 def create(cost_or_topology) -> "Parameters":
     from paddle_tpu.v2.layer import LayerOutput
 
@@ -68,15 +83,7 @@ class Parameters:
     # -- serialization (reference: parameters.to_tar / from_tar) -----------
 
     def to_tar(self, f):
-        with tarfile.open(fileobj=f, mode="w") as tar:
-            for name in self._names:
-                arr = self.get(name)
-                buf = _io.BytesIO()
-                np.save(buf, arr, allow_pickle=False)
-                data = buf.getvalue()
-                info = tarfile.TarInfo(name=name + ".npy")
-                info.size = len(data)
-                tar.addfile(info, _io.BytesIO(data))
+        write_npy_tar(((name, self.get(name)) for name in self._names), f)
 
     @classmethod
     def from_tar(cls, f, topology: Optional[Topology] = None) -> "Parameters":

@@ -1,0 +1,119 @@
+"""Fluid GAN demo (reference: fluid/tests/demo/fc_gan.py): three
+programs over one startup/scope — a D program on real data, a D(G(z))
+program whose clone-point splits off the pure-G program — with
+name-shared parameters (param_attr strings) and per-player
+parameter_list minimization."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    fluid.framework.reset_default_programs()
+    yield
+
+
+NOISE = 4
+DIM = 8
+REAL_MEAN = 2.0
+
+
+def D(x):
+    hidden = fluid.layers.fc(input=x, size=32, act="relu",
+                             param_attr="D.w1", bias_attr="D.b1")
+    return fluid.layers.fc(input=hidden, size=1, act=None,
+                           param_attr="D.w2", bias_attr="D.b2")
+
+
+def G(x):
+    hidden = fluid.layers.fc(input=x, size=32, act="relu",
+                             param_attr="G.w1", bias_attr="G.b1")
+    return fluid.layers.fc(input=hidden, size=DIM, act=None,
+                           param_attr="G.w2", bias_attr="G.b2")
+
+
+def test_fc_gan_trains():
+    rng = np.random.RandomState(5)
+    startup_program = fluid.Program()
+    d_program = fluid.Program()
+    dg_program = fluid.Program()
+
+    with fluid.program_guard(d_program, startup_program):
+        img = fluid.layers.data(name="img", shape=[DIM], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="float32")
+        d_loss = fluid.layers.sigmoid_cross_entropy_with_logits(
+            x=D(img), label=label)
+        d_loss = fluid.layers.mean(x=d_loss)
+
+    with fluid.program_guard(dg_program, startup_program):
+        noise = fluid.layers.data(name="noise", shape=[NOISE],
+                                  dtype="float32")
+        g_img = G(x=noise)
+        g_program = dg_program.clone()
+        dg_loss = fluid.layers.sigmoid_cross_entropy_with_logits(
+            x=D(g_img),
+            label=fluid.layers.fill_constant_batch_size_like(
+                input=noise, dtype="float32", shape=[-1, 1], value=1.0))
+        dg_loss = fluid.layers.mean(x=dg_loss)
+
+    # D's params update through d_program; G's through dg_program with
+    # the parameter_list restriction (the reference's exact setup)
+    g_param_names = [p.name for p in
+                     g_program.global_block().all_parameters()]
+    assert sorted(g_param_names) == ["G.b1", "G.b2", "G.w1", "G.w2"]
+    with fluid.program_guard(d_program, startup_program):
+        fluid.optimizer.Adam(learning_rate=1e-2).minimize(
+            d_loss, startup_program=startup_program)
+    with fluid.program_guard(dg_program, startup_program):
+        fluid.optimizer.Adam(learning_rate=1e-2).minimize(
+            dg_loss, startup_program=startup_program,
+            parameter_list=g_param_names)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup_program)
+
+    B = 64
+
+    def real_batch():
+        return (REAL_MEAN
+                + 0.3 * rng.randn(B, DIM)).astype("float32")
+
+    def noise_batch(n=B):
+        return rng.uniform(-1.0, 1.0, (n, NOISE)).astype("float32")
+
+    (gen0,) = exe.run(g_program, feed={"noise": noise_batch(256)},
+                      fetch_list=[g_img])
+    start_gap = abs(float(np.asarray(gen0).mean()) - REAL_MEAN)
+
+    for _ in range(400):
+        # D step: real=1, fake=0 (two sub-batches, reference interleave)
+        (fake,) = exe.run(g_program, feed={"noise": noise_batch()},
+                          fetch_list=[g_img])
+        exe.run(d_program,
+                feed={"img": real_batch(),
+                      "label": np.ones((B, 1), "float32")},
+                fetch_list=[d_loss])
+        exe.run(d_program,
+                feed={"img": np.asarray(fake),
+                      "label": np.zeros((B, 1), "float32")},
+                fetch_list=[d_loss])
+        # G steps (reference trains DG more often than D)
+        for _ in range(2):
+            exe.run(dg_program, feed={"noise": noise_batch()},
+                    fetch_list=[dg_loss])
+
+    (gen,) = exe.run(g_program, feed={"noise": noise_batch(256)},
+                     fetch_list=[g_img])
+    end_gap = abs(float(np.asarray(gen).mean()) - REAL_MEAN)
+    # the generator distribution moved decisively toward the real one
+    assert end_gap < 0.5 * start_gap, (start_gap, end_gap)
+    assert end_gap < 0.8, end_gap
+
+    # the shared-name contract: D params in d_program and dg_program are
+    # the same scope entries (one copy), G params only in dg/g programs
+    scope = fluid.global_scope()
+    for n in ("D.w1", "D.w2", "G.w1", "G.w2"):
+        assert n in scope, n

@@ -1,0 +1,233 @@
+"""User-tool tests (reference: python/paddle/utils/ — dump_config,
+plotcurve, show_pb, make_model_diagram, torch2paddle, image_util,
+preprocess_img, image_multiproc, predefined_net) plus the reader
+decorators they build on (xmap_readers, pipe_reader,
+ComposeNotAligned)."""
+
+import io
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    fluid.framework.reset_default_programs()
+    yield
+
+
+@pytest.fixture
+def v1_config(tmp_path):
+    cfg = tmp_path / "conf.py"
+    cfg.write_text(
+        "from paddle_tpu.trainer_config_helpers import *\n"
+        "settings(batch_size=8, learning_rate=0.1)\n"
+        "x = data_layer(name='x', size=4)\n"
+        "y = data_layer(name='y', size=1)\n"
+        "h = fc_layer(input=x, size=8, act=TanhActivation())\n"
+        "p = fc_layer(input=h, size=1)\n"
+        "outputs(mse_cost(input=p, label=y))\n")
+    return str(cfg)
+
+
+def test_dump_config(v1_config):
+    from paddle_tpu.utils.dump_config import dump_config
+
+    d = dump_config(v1_config)
+    json.dumps(d, default=str)  # serializable
+    names = {l["name"] for l in d["layers"]}
+    assert {"x", "y"} <= names
+    assert "x" in d["input_layer_names"]
+    assert d["settings"].get("batch_size") == 8
+
+
+def test_make_model_diagram(v1_config, tmp_path):
+    from paddle_tpu.utils.make_model_diagram import make_diagram
+
+    out = str(tmp_path / "m.dot")
+    dot = make_diagram(v1_config, out)
+    assert dot.startswith("digraph")
+    assert '"x"' in dot and "->" in dot
+    assert os.path.exists(out)
+
+
+def test_plotcurve_parses_both_formats(tmp_path):
+    from paddle_tpu.utils.plotcurve import parse_log, plotcurve
+
+    lines = [
+        "Pass 0, Batch 0, Cost 2.001",
+        "Pass 0, Batch 1, Cost 1.520, Eval: classification_error=0.41",
+        "I1117 ... Pass=0 Batch=200 AvgCost=0.9 Eval: error=0.3",
+        "Test done in 1.2s, cost 1.1",
+        "Pass 1, Batch 0, Cost 0.700",
+    ]
+    s = parse_log(lines)
+    assert s["Cost"] == [2.001, 1.52, 0.7]
+    assert s["classification_error"] == [0.41]
+    assert s["TestCost"] == [1.1]
+    s2 = parse_log(lines, keys=["AvgCost"])
+    assert s2["AvgCost"] == [0.9]
+    png = str(tmp_path / "c.png")
+    plotcurve(lines, output=png)
+    assert os.path.getsize(png) > 0
+
+
+def test_show_pb_on_saved_model(tmp_path):
+    from paddle_tpu.utils.show_pb import show
+
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    h = fluid.layers.fc(input=x, size=3, act="relu")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    d = str(tmp_path / "m")
+    fluid.io.save_inference_model(d, ["x"], [h], exe)
+    buf = io.StringIO()
+    info = show(d, out=buf)
+    assert info["feed_names"] == ["x"]
+    assert "fc" in " ".join(info["blocks"][0]["op_types"]) or \
+        "mul" in info["blocks"][0]["op_types"]
+    assert "block 0" in buf.getvalue()
+
+
+def test_torch2paddle_roundtrip(tmp_path):
+    import torch
+
+    from paddle_tpu.utils.torch2paddle import state_dict_to_tar
+
+    sd = {"fc.weight": torch.randn(3, 4), "fc.bias": torch.randn(3)}
+    buf = io.BytesIO()
+    state_dict_to_tar(sd, buf, name_map={"w0": "fc.weight",
+                                         "b0": "fc.bias"})
+    buf.seek(0)
+
+    # read back through the v2 Parameters tar path
+    import paddle_tpu.v2 as paddle
+
+    paddle.init()
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    out = paddle.layer.fc(input=x, size=3,
+                          param_attr=paddle.attr.Param(name="w0"),
+                          bias_attr=paddle.attr.Param(name="b0"))
+    params = paddle.parameters.create(out)
+    params.init_from_tar(buf)
+    np.testing.assert_allclose(params.get("w0"),
+                               sd["fc.weight"].numpy().T, rtol=1e-6)
+    np.testing.assert_allclose(params.get("b0"), sd["fc.bias"].numpy(),
+                               rtol=1e-6)
+
+
+def test_image_util_pipeline(tmp_path, rng=np.random.RandomState(2)):
+    from PIL import Image
+
+    from paddle_tpu.utils import image_util
+
+    p = str(tmp_path / "a.png")
+    Image.fromarray(rng.randint(0, 255, (40, 60, 3), np.uint8)).save(p)
+    img = image_util.load_image(p)
+    assert img.shape == (40, 60, 3)
+    r = image_util.resize_image(img, 32)
+    assert min(r.shape[:2]) == 32 and r.shape[0] == 32  # short side = h
+    c = image_util.crop_img(r, 24, test=True)
+    assert c.shape[:2] == (24, 24)
+    ov = image_util.oversample(r, 24)
+    assert ov.shape == (10, 24, 24, 3)
+    np.testing.assert_array_equal(ov[5], image_util.flip(ov[0]))
+    mean = np.zeros((3, 24, 24), "float32")
+    flat = image_util.preprocess_img(r, mean, 24, is_train=False)
+    assert flat.shape == (3 * 24 * 24,)
+
+
+def test_preprocess_img_dataset(tmp_path, rng=np.random.RandomState(4)):
+    from PIL import Image
+
+    from paddle_tpu.utils.preprocess_img import (
+        ImageClassificationDatasetCreater)
+    from paddle_tpu.utils.preprocess_util import load_batch
+
+    root = tmp_path / "imgs"
+    for label in ("cat", "dog"):
+        d = root / label
+        d.mkdir(parents=True)
+        for i in range(6):
+            Image.fromarray(
+                rng.randint(0, 255, (36, 36, 3), np.uint8)
+            ).save(str(d / f"{i}.png"))
+    creator = ImageClassificationDatasetCreater(str(root), target_size=16,
+                                                batch_size=4,
+                                                test_ratio=0.25)
+    train, test = creator.create(str(tmp_path / "out"))
+    assert train and test
+    data, labels = load_batch(train[0])
+    assert data.shape[1:] == (3, 16, 16)
+    assert set(np.unique(labels)) <= {0, 1}
+    with np.load(str(tmp_path / "out" / "meta.npz")) as meta:
+        assert meta["mean"].shape == (3, 16, 16)
+    labels_txt = (tmp_path / "out" / "labels.txt").read_text()
+    assert "cat" in labels_txt and "dog" in labels_txt
+
+
+def test_image_multiproc_transformer(rng=np.random.RandomState(6)):
+    from paddle_tpu.utils.image_multiproc import (PixelTransformer,
+                                                  multiproc_reader)
+
+    imgs = [(rng.randint(0, 255, (40, 40, 3), np.uint8), i % 2)
+            for i in range(12)]
+    tf = PixelTransformer(target_size=32, crop_size=24, is_train=False)
+    out = list(multiproc_reader(lambda: iter(imgs), tf, workers=3,
+                                buffer_size=4, order=True)())
+    assert len(out) == 12
+    assert out[0][0].shape == (3, 24, 24)
+    assert [l for _, l in out] == [i % 2 for i in range(12)]
+
+
+def test_predefined_net_registry():
+    from paddle_tpu.utils.predefined_net import get_predefined_net
+
+    net = get_predefined_net("lenet5")
+    img = fluid.layers.data(name="img", shape=[1, 28, 28],
+                            dtype="float32")
+    pred = net(img)
+    assert pred.shape[-1] == 10
+    with pytest.raises(KeyError):
+        get_predefined_net("nope")
+
+
+def test_merge_model_cli(tmp_path, v1_config):
+    """python -m paddle_tpu.utils.merge_model round-trips through the
+    trainer save dir into an inference model dir."""
+    from paddle_tpu.trainer.config_parser import parse_config
+    from paddle_tpu.trainer.trainer import Trainer
+    from paddle_tpu.utils.merge_model import merge_v2_model
+
+    conf = parse_config(v1_config)
+    t = Trainer(conf)
+    pass_dir = tmp_path / "save" / "pass-00000"
+    pass_dir.mkdir(parents=True)
+    with open(pass_dir / "params.tar", "wb") as f:
+        t.parameters.to_tar(f)
+    out = str(tmp_path / "merged")
+    merge_v2_model(v1_config, str(tmp_path / "save"), out)
+    assert os.path.exists(os.path.join(out, "__model__.json"))
+
+
+def test_utils_cli_entrypoints(tmp_path, v1_config):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.utils.dump_config", v1_config],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout)["input_layer_names"]
+    log = tmp_path / "t.log"
+    log.write_text("Pass 0, Batch 0, Cost 3.0\nPass 0, Batch 1, Cost 1.0\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.utils.plotcurve",
+         "-i", str(log)],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "Cost" in r.stdout
