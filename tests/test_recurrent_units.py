@@ -1,0 +1,194 @@
+"""trainer.recurrent_units tests (reference:
+python/paddle/trainer/recurrent_units.py): the hand-composable LSTM/GRU
+units must run inside recurrent_group and, with shared parameter names,
+match the proven lstmemory_group / gru_group computations exactly.
+Also covers the PyDataProviderWrapper back-compat shim and
+config_parser_extension."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.v2 as paddle
+from paddle_tpu.param_attr import ParamAttr
+from paddle_tpu.initializer import ConstantInitializer
+from paddle_tpu.v2.inference import Inference
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    fluid.framework.reset_default_programs()
+    paddle.init(use_gpu=False, trainer_count=1)
+    yield
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(9)
+
+
+def _rows(rng, n, lens, dim):
+    return [[ [rng.randn(dim).astype("float32").tolist()
+               for _ in range(l)] ] for l in lens[:n]]
+
+
+def test_lstm_layer_group_matches_lstmemory_group(rng):
+    """LstmRecurrentLayerGroup == lstmemory_group when every parameter
+    is name-shared (reference equivalence: recurrent_units vs
+    networks.py lstm groups over one proto machinery)."""
+    from paddle_tpu.trainer.recurrent_units import LstmRecurrentLayerGroup
+    from paddle_tpu.trainer_config_helpers import (
+        full_matrix_projection, last_seq, concat_layer, mixed_layer,
+        networks)
+
+    D, H = 4, 6
+    x = paddle.layer.data(
+        name="x", type=paddle.data_type.dense_vector_sequence(D))
+
+    a = LstmRecurrentLayerGroup(
+        name="lstmA", size=H, active_type="tanh",
+        state_active_type="tanh", gate_active_type="sigmoid",
+        inputs=[full_matrix_projection(
+            input=x, param_attr=ParamAttr(name="W_x"))],
+        para_prefix="shared")
+
+    with mixed_layer(size=4 * H, bias_attr=ParamAttr(
+            name="shared_input_recurrent.b",
+            initializer=ConstantInitializer(0.0))) as proj:
+        proj += full_matrix_projection(input=x,
+                                       param_attr=ParamAttr(name="W_x"))
+    b = networks.lstmemory_group(
+        input=proj._lo, size=H,
+        param_attr=ParamAttr(name="shared_input_recurrent.w"),
+        lstm_bias_attr=ParamAttr(name="shared_check.b"),
+        input_proj_bias_attr=False)
+
+    both = concat_layer(input=[last_seq(input=a), last_seq(input=b)])
+    params = paddle.parameters.create(both)
+    got = np.asarray(Inference(both, params).infer(
+        _rows(rng, 3, [5, 3, 4], D)))
+    assert got.shape == (3, 2 * H)
+    assert np.isfinite(got).all()
+    # the A bias adds where B has none — but it is zero-initialized, so
+    # at init the two towers are the same function of the same weights
+    np.testing.assert_allclose(got[:, :H], got[:, H:], rtol=1e-5,
+                               atol=1e-6)
+    assert np.abs(got[:, :H]).max() > 1e-4  # non-degenerate
+
+
+def test_gru_layer_group_matches_gru_group(rng):
+    from paddle_tpu.trainer.recurrent_units import GatedRecurrentLayerGroup
+    from paddle_tpu.trainer_config_helpers import (
+        full_matrix_projection, last_seq, concat_layer, mixed_layer,
+        networks)
+
+    D, H = 4, 5
+    x = paddle.layer.data(
+        name="x", type=paddle.data_type.dense_vector_sequence(D))
+
+    a = GatedRecurrentLayerGroup(
+        name="gruA", size=H, active_type="tanh",
+        gate_active_type="sigmoid",
+        inputs=[full_matrix_projection(
+            input=x, param_attr=ParamAttr(name="Wg_x"))],
+        para_prefix="gshare")
+
+    with mixed_layer(size=3 * H, bias_attr=ParamAttr(
+            name="gshare_input_proj.b",
+            initializer=ConstantInitializer(0.0))) as proj:
+        proj += full_matrix_projection(input=x,
+                                       param_attr=ParamAttr(name="Wg_x"))
+    b = networks.gru_group(
+        input=proj._lo, size=H,
+        gru_param_attr=ParamAttr(name="gshare_gate_weight"),
+        gru_bias_attr=ParamAttr(name="gshare_gate_bias"))
+
+    both = concat_layer(input=[last_seq(input=a), last_seq(input=b)])
+    params = paddle.parameters.create(both)
+    got = np.asarray(Inference(both, params).infer(
+        _rows(rng, 3, [4, 2, 6], D)))
+    assert got.shape == (3, 2 * H)
+    np.testing.assert_allclose(got[:, :H], got[:, H:], rtol=1e-5,
+                               atol=1e-6)
+    assert np.abs(got[:, :H]).max() > 1e-4
+
+
+def test_unit_inside_user_recurrent_group(rng):
+    """GatedRecurrentUnit used directly inside a user step function —
+    the reference's primary calling convention."""
+    from paddle_tpu.trainer.recurrent_units import GatedRecurrentUnit
+    from paddle_tpu.trainer_config_helpers import (
+        full_matrix_projection, identity_projection, last_seq,
+        recurrent_group)
+
+    D, H = 3, 4
+    x = paddle.layer.data(
+        name="x", type=paddle.data_type.dense_vector_sequence(D))
+
+    def step(x_t):
+        return GatedRecurrentUnit(
+            name="g1", size=H, active_type="tanh",
+            gate_active_type="sigmoid",
+            inputs=[full_matrix_projection(input=x_t)])
+
+    out = recurrent_group(step=step, input=x)
+    pooled = last_seq(input=out)
+    params = paddle.parameters.create(pooled)
+    got = np.asarray(Inference(pooled, params).infer(
+        _rows(rng, 2, [3, 5], D)))
+    assert got.shape == (2, H) and np.isfinite(got).all()
+
+
+def test_para_prefix_shares_parameters(rng):
+    """Two layer groups with one para_prefix share weights; distinct
+    prefixes do not (reference: the para_prefix contract)."""
+    from paddle_tpu.trainer.recurrent_units import LstmRecurrentLayerGroup
+    from paddle_tpu.trainer_config_helpers import (
+        full_matrix_projection, last_seq, concat_layer)
+
+    D, H = 3, 4
+    x = paddle.layer.data(
+        name="x", type=paddle.data_type.dense_vector_sequence(D))
+    mk = lambda nm, pp: LstmRecurrentLayerGroup(  # noqa: E731
+        name=nm, size=H, active_type="tanh", state_active_type="tanh",
+        gate_active_type="sigmoid",
+        inputs=[full_matrix_projection(
+            input=x, param_attr=ParamAttr(name="W_shared_in"))],
+        para_prefix=pp)
+    a, b, c = mk("u1", "pfx"), mk("u2", "pfx"), mk("u3", "other")
+    out = concat_layer(input=[last_seq(input=l) for l in (a, b, c)])
+    params = paddle.parameters.create(out)
+    names = set(params.keys())
+    assert "pfx_input_recurrent.w" in names
+    assert "other_input_recurrent.w" in names
+    got = np.asarray(Inference(out, params).infer(_rows(rng, 2, [4, 3], D)))
+    np.testing.assert_allclose(got[:, :H], got[:, H:2 * H], rtol=1e-6)
+    assert not np.allclose(got[:, :H], got[:, 2 * H:])
+
+
+def test_pydataprovider_wrapper_shim():
+    from paddle_tpu.trainer.PyDataProviderWrapper import (DenseSlot,
+                                                          IndexSlot,
+                                                          PoolSize,
+                                                          provider)
+
+    with pytest.warns(DeprecationWarning):
+        @provider(slots=[DenseSlot(4), IndexSlot(3)],
+                  pool_size=PoolSize(16))
+        def process(obj, filename):
+            for i in range(3):
+                yield [float(i)] * 4, i % 3
+
+    types = process.input_types
+    assert types[0].dim == 4 and types[1].dim == 3
+    rows = list(process(None))
+    assert len(rows) == 3 and rows[1][1] == 1
+
+
+def test_config_parser_extension():
+    from paddle_tpu.trainer.config_parser_extension import (
+        SimpleData, get_config_funcs)
+
+    funcs = get_config_funcs("cfg")
+    d = funcs["SimpleData"](files="f.list", feat_dim=10)
+    assert d["type"] == "simple" and d["feat_dim"] == 10
