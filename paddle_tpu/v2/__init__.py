@@ -36,7 +36,8 @@ def __getattr__(name):
     # package — loading them lazily keeps the import graph acyclic
     # (reference surface: python/paddle/v2/{evaluator,op,data_feeder,
     # config_base}.py)
-    if name in ("evaluator", "op", "data_feeder", "config_base"):
+    if name in ("evaluator", "op", "data_feeder", "config_base",
+                "fluid"):
         import importlib
 
         mod = importlib.import_module(f"paddle_tpu.v2.{name}")

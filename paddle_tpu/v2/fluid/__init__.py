@@ -1,0 +1,30 @@
+"""Path alias (reference: python/paddle/v2/fluid/): the fluid API
+lives at the paddle_tpu top level in this repo; this package makes the
+reference's import spellings run verbatim —
+``import paddle_tpu.v2.fluid as fluid``,
+``from paddle_tpu.v2.fluid import layers``, and
+``import paddle_tpu.v2.fluid.layers``."""
+
+import importlib
+import sys
+
+import paddle_tpu as _root
+
+_SUBMODULES = [
+    "layers", "nets", "optimizer", "regularizer", "initializer",
+    "framework", "executor", "backward", "io", "evaluator", "profiler",
+    "param_attr", "net_drawer", "data_feeder", "registry",
+    "default_scope_funcs", "layer_helper", "clip",
+]
+
+for _m in _SUBMODULES:
+    _mod = importlib.import_module(f"paddle_tpu.{_m}")
+    globals()[_m] = _mod
+    sys.modules[__name__ + "." + _m] = _mod
+del _m, _mod
+
+
+def __getattr__(name):
+    # everything else (Program, Executor, CPUPlace, program_guard,
+    # default_main_program, ...) forwards to the top-level fluid API
+    return getattr(_root, name)

@@ -186,3 +186,22 @@ def test_config_base_layer_alias():
     assert Layer is LayerOutput
     f = lambda: 1  # noqa: E731
     assert __convert_to_v2__(f, "f", "m") is f
+
+
+def test_v2_fluid_path_alias(rng):
+    """Reference-style ``import paddle.v2.fluid as fluid`` spellings
+    work verbatim (reference: python/paddle/v2/fluid/__init__.py)."""
+    import paddle_tpu.v2.fluid as fl
+    import paddle_tpu.v2.fluid.layers as fl_layers
+    from paddle_tpu.v2.fluid import nets, io  # noqa: F401
+
+    assert fl_layers is fluid.layers
+    assert fl.Program is fluid.Program
+    assert paddle.fluid.executor is fluid.executor
+    x = fl.layers.data(name="xa", shape=[4], dtype="float32")
+    h = fl.layers.fc(input=x, size=2)
+    exe = fl.Executor(fl.CPUPlace())
+    exe.run(fl.default_startup_program())
+    (out,) = exe.run(feed={"xa": rng.randn(3, 4).astype("float32")},
+                     fetch_list=[h])
+    assert np.asarray(out).shape == (3, 2)
