@@ -12,8 +12,9 @@
 // op set (mul, elementwise add/mul/sub with paddle axis broadcast,
 // conv2d, pool2d max/avg, relu/sigmoid/tanh/softmax/scale, reshape,
 // dropout/batch_norm in inference form, lookup_table,
-// context_project, padded_sequence_pool) — enough for LeNet-class
-// image models AND the quick_start text classifier (reference bar:
+// context_project, padded_sequence_pool, fused lstm/gru, concat) —
+// enough for LeNet-class image models, the quick_start text
+// classifier, and recurrent LSTM/GRU classifiers (reference bar:
 // capi/examples/model_inference/sequence/main.c) — and fails with a
 // clear error naming any op outside it.
 //
@@ -712,6 +713,163 @@ int RunOp(Machine* m, const Json& op) {
           bias->data[ch];
     }
     m->values[OutName(op, "Y")] = std::move(out);
+    return 0;
+  }
+  if (type == "lstm") {
+    // Fused inference LSTM over padded (B, T, 4H) pre-projected gates
+    // (semantics: ops/sequence_ops.py _lstm — gate split order
+    // i,f,c̃,o; Weight (H, 4H) recurrent; Bias (1, 4H) or (1, 7H)
+    // with peephole tails w_ic/w_fc/w_oc).
+    Tensor* x = val("Input");
+    Tensor* w = val("Weight");
+    Tensor* b = val("Bias");
+    if (!x || !w) return Fail("lstm: missing input");
+    if (x->dims.size() != 3) return Fail("lstm: Input must be (B,T,4H)");
+    const std::string ga = AttrStr(op, "gate_activation", "sigmoid");
+    const std::string ca = AttrStr(op, "cell_activation", "tanh");
+    const std::string da = AttrStr(op, "candidate_activation", "tanh");
+    if (ga != "sigmoid" || ca != "tanh" || da != "tanh")
+      return Fail("lstm: only default activations in the native path");
+    int64_t B = x->dims[0], T = x->dims[1], H4 = x->dims[2], H = H4 / 4;
+    bool reverse = AttrNum(op, "is_reverse", 0) != 0;
+    bool peep = AttrNum(op, "use_peepholes", 0) != 0 && b &&
+                b->numel() == 7 * H;
+    const float* bg = b ? b->data.data() : nullptr;            // 4H
+    const float* wic = peep ? bg + 4 * H : nullptr;
+    const float* wfc = peep ? bg + 5 * H : nullptr;
+    const float* woc = peep ? bg + 6 * H : nullptr;
+    Tensor hid, cell;
+    hid.dims = {B, T, H};
+    hid.data.assign(B * T * H, 0.f);
+    cell = hid;
+    std::vector<float> h(B * H, 0.f), c(B * H, 0.f), gates(4 * H);
+    auto sigm = [](float v) { return 1.f / (1.f + std::exp(-v)); };
+    for (int64_t step = 0; step < T; ++step) {
+      int64_t t = reverse ? T - 1 - step : step;
+      for (int64_t row = 0; row < B; ++row) {
+        const float* xt = &x->data[(row * T + t) * H4];
+        float* hr = &h[row * H];
+        float* cr = &c[row * H];
+        for (int64_t j = 0; j < H4; ++j)
+          gates[j] = xt[j] + (bg ? bg[j] : 0.f);
+        for (int64_t k = 0; k < H; ++k) {
+          float hv = hr[k];
+          if (hv == 0.f) continue;
+          const float* wr = &w->data[k * H4];
+          for (int64_t j = 0; j < H4; ++j) gates[j] += hv * wr[j];
+        }
+        for (int64_t k = 0; k < H; ++k) {
+          float gi = gates[k], gf = gates[H + k];
+          if (peep) {
+            gi += wic[k] * cr[k];
+            gf += wfc[k] * cr[k];
+          }
+          float i = sigm(gi);
+          float f = sigm(gf);
+          float cand = std::tanh(gates[2 * H + k]);
+          float cn = f * cr[k] + i * cand;
+          float go = gates[3 * H + k];
+          if (peep) go += woc[k] * cn;
+          float o = sigm(go);
+          cr[k] = cn;
+          hr[k] = o * std::tanh(cn);
+          hid.data[(row * T + t) * H + k] = hr[k];
+          cell.data[(row * T + t) * H + k] = cn;
+        }
+      }
+    }
+    std::string hname = OutName(op, "Hidden");
+    std::string cname = OutName(op, "Cell");
+    if (!cname.empty()) m->values[cname] = std::move(cell);
+    if (!hname.empty()) m->values[hname] = std::move(hid);
+    return 0;
+  }
+  if (type == "gru") {
+    // Fused inference GRU over padded (B, T, 3H) (semantics:
+    // ops/sequence_ops.py _gru — Weight (H, 3H) = [W_uz | W_c],
+    // gates u,r from the first 2H, candidate from the last H).
+    Tensor* x = val("Input");
+    Tensor* w = val("Weight");
+    Tensor* b = val("Bias");
+    if (!x || !w) return Fail("gru: missing input");
+    if (x->dims.size() != 3) return Fail("gru: Input must be (B,T,3H)");
+    if (AttrStr(op, "gate_activation", "sigmoid") != std::string("sigmoid") ||
+        AttrStr(op, "activation", "tanh") != std::string("tanh"))
+      return Fail("gru: only default activations in the native path");
+    int64_t B = x->dims[0], T = x->dims[1], H3 = x->dims[2], H = H3 / 3;
+    bool reverse = AttrNum(op, "is_reverse", 0) != 0;
+    const float* bias = b ? b->data.data() : nullptr;  // (1, 3H)
+    Tensor hid;
+    hid.dims = {B, T, H};
+    hid.data.assign(B * T * H, 0.f);
+    std::vector<float> h(B * H, 0.f), uz(2 * H), cand(H);
+    auto sigm = [](float v) { return 1.f / (1.f + std::exp(-v)); };
+    for (int64_t step = 0; step < T; ++step) {
+      int64_t t = reverse ? T - 1 - step : step;
+      for (int64_t row = 0; row < B; ++row) {
+        const float* xt = &x->data[(row * T + t) * H3];
+        float* hr = &h[row * H];
+        for (int64_t j = 0; j < 2 * H; ++j)
+          uz[j] = xt[j] + (bias ? bias[j] : 0.f);
+        for (int64_t k = 0; k < H; ++k) {
+          float hv = hr[k];
+          if (hv == 0.f) continue;
+          const float* wr = &w->data[k * H3];  // first 2H of row k
+          for (int64_t j = 0; j < 2 * H; ++j) uz[j] += hv * wr[j];
+        }
+        for (int64_t j = 0; j < 2 * H; ++j) uz[j] = sigm(uz[j]);
+        // candidate: x_c + (r*h)·W_c + b_c
+        for (int64_t k = 0; k < H; ++k)
+          cand[k] = xt[2 * H + k] + (bias ? bias[2 * H + k] : 0.f);
+        for (int64_t k = 0; k < H; ++k) {
+          float rh = uz[H + k] * hr[k];
+          if (rh == 0.f) continue;
+          const float* wr = &w->data[k * H3] + 2 * H;
+          for (int64_t j = 0; j < H; ++j) cand[j] += rh * wr[j];
+        }
+        for (int64_t k = 0; k < H; ++k) {
+          float u = uz[k];
+          float cn = std::tanh(cand[k]);
+          hr[k] = u * hr[k] + (1.f - u) * cn;
+          hid.data[(row * T + t) * H + k] = hr[k];
+        }
+      }
+    }
+    m->values[OutName(op, "Hidden")] = std::move(hid);
+    return 0;
+  }
+  if (type == "concat") {
+    const Json* ins = op.Get("inputs");
+    const Json* xs = ins ? ins->Get("X") : nullptr;
+    if (!xs || xs->arr.empty()) return Fail("concat: missing inputs");
+    std::vector<Tensor*> parts;
+    for (auto& nm : xs->arr) {
+      auto it = m->values.find(nm.str);
+      if (it == m->values.end()) return Fail("concat: missing " + nm.str);
+      parts.push_back(&it->second);
+    }
+    int axis = static_cast<int>(AttrNum(op, "axis", 0));
+    int rank = static_cast<int>(parts[0]->dims.size());
+    if (axis < 0) axis += rank;
+    if (axis < 0 || axis >= rank) return Fail("concat: bad axis");
+    Tensor out;
+    out.dims = parts[0]->dims;
+    int64_t axis_total = 0;
+    for (auto* p : parts) axis_total += p->dims[axis];
+    out.dims[axis] = axis_total;
+    int64_t outer = 1, inner = 1;
+    for (int i = 0; i < axis; ++i) outer *= out.dims[i];
+    for (int i = axis + 1; i < rank; ++i) inner *= out.dims[i];
+    out.data.assign(outer * axis_total * inner, 0.f);
+    int64_t off = 0;
+    for (auto* p : parts) {
+      int64_t pa = p->dims[axis];
+      for (int64_t o = 0; o < outer; ++o)
+        std::copy(&p->data[o * pa * inner], &p->data[(o + 1) * pa * inner],
+                  &out.data[(o * axis_total + off) * inner]);
+      off += pa;
+    }
+    m->values[OutName(op, "Out")] = std::move(out);
     return 0;
   }
   return Fail("native capi: op '" + type +

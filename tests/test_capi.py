@@ -128,19 +128,19 @@ def test_native_c_program_names_unsupported_op(capi_native_binary,
     import paddle_tpu as fluid
 
     fluid.framework.reset_default_programs()
-    # lstm is well outside the convnet inference set (conv2d/pool2d
-    # moved INTO the native set in round 4)
-    x = fluid.layers.data(name="x", shape=[12, 32], dtype="float32",
+    # lrn is outside the native inference set (conv2d/pool2d moved in
+    # during round 4; lstm/gru in round 5)
+    x = fluid.layers.data(name="x", shape=[4, 8, 8], dtype="float32",
                           append_batch_size=True)
-    h, _c = fluid.layers.lstm(input=x, size=8)
+    h = fluid.layers.lrn(input=x)
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(fluid.default_startup_program())
-    d = str(tmp_path / "lstmmodel")
+    d = str(tmp_path / "lrnmodel")
     fluid.io.save_inference_model(d, ["x"], [h], exe)
-    out = subprocess.run([capi_native_binary, d, "384"],
+    out = subprocess.run([capi_native_binary, d, "256"],
                          capture_output=True, text=True, timeout=60)
     assert out.returncode == 1
-    assert "lstm" in out.stderr and "embedded-Python" in out.stderr
+    assert "lrn" in out.stderr and "embedded-Python" in out.stderr
 
 
 @pytest.fixture(scope="module")
@@ -244,6 +244,119 @@ def test_native_c_program_runs_sequence_model(capi_native_binary,
                          capture_output=True, text=True, env=env,
                          timeout=60)
     assert out.returncode == 0, out.stderr
+    rows = [l for l in out.stdout.splitlines() if l.startswith("probs[")]
+    assert len(rows) == 2, out.stdout
+    got = np.array([[float(t) for t in r.split(":")[1].split()]
+                    for r in rows], np.float32)
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(got.sum(1), 1.0, atol=1e-4)
+
+
+def _save_recurrent_classifier(tmp_path_factory, kind, rng_seed=13):
+    """Build + briefly train an embedding→projection→{lstm|gru}→masked
+    max-pool→softmax classifier in fluid, export the inference slice,
+    and return (model_dir, expected probs) for the canonical 2-row
+    padded batch."""
+    import paddle_tpu as fluid
+    import paddle_tpu.executor as executor_mod
+    from paddle_tpu.layer_helper import LayerHelper
+
+    fluid.framework.reset_default_programs()
+    rng = np.random.RandomState(rng_seed)
+    vocab, T, E, H, classes = 30, 4, 8, 8, 2
+    # declared with the paddle trailing-1 ids convention so embedding
+    # infers (B, T, E); fed as plain (B, T) at runtime (both the Python
+    # lowering and the C interpreter look rows up by value)
+    ids = fluid.layers.data(name="word", shape=[-1, -1, 1], dtype="int64",
+                            append_batch_size=False)
+    lens = fluid.layers.data(name="word@len", shape=[1], dtype="int64")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    emb = fluid.layers.embedding(ids, size=[vocab, E])
+    if kind == "lstm":
+        proj = fluid.layers.fc(input=emb, size=4 * H, num_flatten_dims=2)
+        hidden, _cell = fluid.layers.dynamic_lstm(input=proj, size=H)
+    else:
+        proj = fluid.layers.fc(input=emb, size=3 * H, num_flatten_dims=2)
+        helper = LayerHelper("gru")
+        w = helper.create_parameter(None, shape=[H, 3 * H],
+                                    dtype="float32")
+        b = helper.create_parameter(None, shape=[1, 3 * H],
+                                    dtype="float32", is_bias=True)
+        hidden = helper.create_tmp_variable("float32", (-1, T, H))
+        helper.append_op(type="gru",
+                         inputs={"Input": [proj], "Weight": [w],
+                                 "Bias": [b]},
+                         outputs={"Hidden": [hidden]}, attrs={})
+    def pool(ptype):
+        helper = LayerHelper("padded_sequence_pool")
+        out = helper.create_tmp_variable("float32", (-1, H))
+        helper.append_op(type="padded_sequence_pool",
+                         inputs={"X": [hidden], "Length": [lens]},
+                         outputs={"Out": [out]},
+                         attrs={"pooltype": ptype})
+        return out
+
+    # max-pool ⊕ last-step features (exercises native concat too)
+    pooled = fluid.layers.concat([pool("MAX"), pool("LAST")], axis=1)
+    pred = fluid.layers.fc(input=pooled, size=classes, act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=pred, label=label))
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    for _ in range(25):
+        xs = rng.randint(1, vocab, (32, T))
+        ls = rng.randint(1, T + 1, 32)
+        for r in range(32):
+            xs[r, ls[r]:] = 0
+        ys = (xs[:, 0] < vocab // 2).astype(np.int64)
+        exe.run(feed={"word": xs.astype(np.int64),
+                      "word@len": ls.astype(np.int64),
+                      "label": ys.reshape(-1, 1)},
+                fetch_list=[loss])
+
+    d = str(tmp_path_factory.mktemp(f"c_{kind}"))
+    fluid.io.save_inference_model(d, ["word", "word@len"], [pred], exe)
+
+    ids_b = np.array([[3, 7, 11, 5], [3, 7, 0, 0]], np.int64)
+    lens_b = np.array([4, 2], np.int64)
+    fluid.framework.reset_default_programs()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = executor_mod.Scope()
+    with executor_mod.scope_guard(scope):
+        prog, feeds, fetches = fluid.io.load_inference_model(d, exe)
+        (expected,) = exe.run(prog, feed={"word": ids_b,
+                                          "word@len": lens_b},
+                              fetch_list=fetches)
+    return d, np.asarray(expected)
+
+
+@pytest.mark.parametrize("kind", ["lstm", "gru"])
+def test_native_c_program_runs_recurrent_model(capi_native_binary,
+                                               tmp_path_factory, kind):
+    """Recurrent inference from pure C: the native interpreter's fused
+    lstm/gru ops (paddle_tpu_capi_native.cc) must reproduce the XLA
+    lowering (ops/sequence_ops.py _lstm/_gru) exactly through the same
+    padded ids + lengths ABI."""
+    d = os.path.dirname(capi_native_binary)
+    exe = os.path.join(d, f"{kind}_infer_native")
+    lib = os.path.join(d, "libpaddle_tpu_capi_native.so")
+    subprocess.run(
+        ["g++", "-O2", os.path.join(CAPI, "examples", "sequence_infer.c"),
+         "-o", exe, "-I", CAPI, lib, f"-Wl,-rpath,{d}"],
+        check=True, capture_output=True)
+    ldd = subprocess.run(["ldd", exe], capture_output=True, text=True)
+    assert "libpython" not in ldd.stdout, ldd.stdout
+
+    model_dir, expected = _save_recurrent_classifier(tmp_path_factory,
+                                                     kind)
+    env = dict(os.environ)
+    env.pop("PADDLE_TPU_ROOT", None)
+    out = subprocess.run([exe, model_dir, "3", "7", "11", "5"],
+                         capture_output=True, text=True, env=env,
+                         timeout=60)
+    assert out.returncode == 0, out.stderr or out.stdout
     rows = [l for l in out.stdout.splitlines() if l.startswith("probs[")]
     assert len(rows) == 2, out.stdout
     got = np.array([[float(t) for t in r.split(":")[1].split()]
