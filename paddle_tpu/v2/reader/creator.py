@@ -50,3 +50,30 @@ def cloud_reader(paths, etcd_endpoints=None, timeout_sec=5, buf_size=64):
                     yield line.rstrip(b"\n")
 
     return reader
+
+
+def recordio(paths, buf_size=100):
+    """Read pickled samples out of recordio shard files (reference:
+    v2/reader/creator.py:60 — there via the recordio python package;
+    here via the native C++ RecordIOReader).  ``paths`` may be one
+    glob/path string or a list; records that unpickle are yielded as
+    objects, raw bytes otherwise."""
+    import glob as _glob
+    import pickle
+
+    if isinstance(paths, str):
+        path_list = sorted(_glob.glob(paths)) or [paths]
+    else:
+        path_list = list(paths)
+
+    def reader():
+        from paddle_tpu.native import RecordIOReader
+
+        for p in path_list:
+            for rec in RecordIOReader(p):
+                try:
+                    yield pickle.loads(rec)
+                except Exception:
+                    yield rec
+
+    return reader

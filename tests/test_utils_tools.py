@@ -231,3 +231,20 @@ def test_utils_cli_entrypoints(tmp_path, v1_config):
         capture_output=True, text=True, env=env, timeout=120)
     assert r.returncode == 0, r.stderr
     assert "Cost" in r.stdout
+
+
+def test_recordio_creator(tmp_path):
+    """paddle.v2.reader.creator.recordio over native recordio shards
+    (reference: v2/reader/creator.py:60)."""
+    import pickle
+
+    from paddle_tpu.native import RecordIOWriter
+    from paddle_tpu.v2.reader.creator import recordio
+
+    for shard in range(2):
+        w = RecordIOWriter(str(tmp_path / f"data-{shard:03d}"))
+        for i in range(4):
+            w.write(pickle.dumps((shard, i)))
+        w.close()
+    got = list(recordio(str(tmp_path / "data-*"))())
+    assert got == [(s, i) for s in range(2) for i in range(4)]
