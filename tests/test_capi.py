@@ -272,9 +272,10 @@ def _save_recurrent_classifier(tmp_path_factory, kind, rng_seed=13):
     lens = fluid.layers.data(name="word@len", shape=[1], dtype="int64")
     label = fluid.layers.data(name="label", shape=[1], dtype="int64")
     emb = fluid.layers.embedding(ids, size=[vocab, E])
-    if kind == "lstm":
+    if kind.startswith("lstm"):
         proj = fluid.layers.fc(input=emb, size=4 * H, num_flatten_dims=2)
-        hidden, _cell = fluid.layers.dynamic_lstm(input=proj, size=H)
+        hidden, _cell = fluid.layers.dynamic_lstm(
+            input=proj, size=H, use_peepholes=(kind == "lstm_peephole"))
     else:
         proj = fluid.layers.fc(input=emb, size=3 * H, num_flatten_dims=2)
         helper = LayerHelper("gru")
@@ -332,7 +333,7 @@ def _save_recurrent_classifier(tmp_path_factory, kind, rng_seed=13):
     return d, np.asarray(expected)
 
 
-@pytest.mark.parametrize("kind", ["lstm", "gru"])
+@pytest.mark.parametrize("kind", ["lstm", "lstm_peephole", "gru"])
 def test_native_c_program_runs_recurrent_model(capi_native_binary,
                                                tmp_path_factory, kind):
     """Recurrent inference from pure C: the native interpreter's fused
