@@ -161,6 +161,18 @@ class GradientMachine:
     def getParameters(self):
         return self.parameters
 
+    def getParameterSize(self):
+        """reference api GradientMachine::getParameterSize."""
+        return len(self.parameters.keys())
+
+    def getParameter(self, i):
+        """reference api GradientMachine::getParameter — the swig
+        Parameter wrapper (defined below) over the i-th parameter."""
+        names = self.parameters.keys()
+        if not 0 <= i < len(names):
+            raise RangeError(i)
+        return Parameter(self.parameters, names[i])
+
     def getLayerOutputs(self, names):
         raise NotImplementedError(
             "fetch intermediate layers by adding them to output_layers")
@@ -177,3 +189,350 @@ class SequenceGenerator:
 
     def generate(self, row):
         return self._gen.generate(row)
+
+
+# ---------------------------------------------------------------------------
+# SWIG numeric buffer types (reference: api/PaddleAPI.h Matrix:103,
+# Vector:244, IVector:323 + api/Matrix.cpp / Vector.cpp).  numpy IS the
+# buffer; `inplace` accessors return views, `copyTo*` return copies,
+# exactly the py_paddle contract.
+# ---------------------------------------------------------------------------
+
+
+class UnsupportError(RuntimeError):
+    """reference api/PaddleAPI.h:61"""
+
+
+class RangeError(IndexError):
+    """reference api/PaddleAPI.h:58"""
+
+
+class Matrix:
+    """Dense (numpy f32) or CSR-sparse 2-D buffer."""
+
+    def __init__(self, arr=None, sparse=None, shape=None):
+        self._arr = arr          # np (h, w) f32 when dense
+        self._sparse = sparse    # (indptr, cols, vals|None) when sparse
+        self._shape = shape if shape is not None else (
+            arr.shape if arr is not None else (0, 0))
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def createZero(height, width, useGpu=False):
+        return Matrix(np.zeros((height, width), np.float32))
+
+    @staticmethod
+    def createDense(data, height, width, useGpu=False):
+        return Matrix(np.asarray(data, np.float32).reshape(height, width)
+                      .copy())
+
+    @staticmethod
+    def createDenseFromNumpy(data, copy=True, useGpu=False):
+        a = np.asarray(data, np.float32)
+        if a.ndim != 2:
+            raise UnsupportError("createDenseFromNumpy needs a 2-D array")
+        return Matrix(a.copy() if copy else a)
+
+    createCpuDenseFromNumpy = createDenseFromNumpy
+    createGpuDenseFromNumpy = createDenseFromNumpy
+
+    @staticmethod
+    def createSparse(height, width, nnz, isNonVal=True, trans=False,
+                     useGpu=False):
+        m = Matrix(shape=(height, width))
+        m._sparse = (np.zeros(height + 1, np.int64),
+                     np.zeros(0, np.int64),
+                     None if isNonVal else np.zeros(0, np.float32))
+        return m
+
+    def sparseCopyFrom(self, rows, cols, values=()):
+        """CSR fill: ``rows`` = row offsets (len h+1), ``cols`` = column
+        indices, ``values`` empty for binary (non-value) sparse."""
+        if self._sparse is None:
+            raise UnsupportError("sparseCopyFrom on a dense Matrix")
+        vals = (np.asarray(values, np.float32) if len(values)
+                else (None if self._sparse[2] is None
+                      else np.zeros(len(cols), np.float32)))
+        self._sparse = (np.asarray(rows, np.int64),
+                        np.asarray(cols, np.int64), vals)
+
+    # -- accessors ---------------------------------------------------------
+    def isSparse(self):
+        return self._sparse is not None
+
+    def isGpu(self):
+        return False
+
+    def getHeight(self):
+        return int(self._shape[0])
+
+    def getWidth(self):
+        return int(self._shape[1])
+
+    def get(self, x, y):
+        self._check_dense()
+        if not (0 <= x < self._shape[0] and 0 <= y < self._shape[1]):
+            raise RangeError((x, y))
+        return float(self._arr[x, y])
+
+    def set(self, x, y, val):
+        self._check_dense()
+        if not (0 <= x < self._shape[0] and 0 <= y < self._shape[1]):
+            raise RangeError((x, y))
+        self._arr[x, y] = val
+
+    def getData(self):
+        self._check_dense()
+        return self._arr.ravel().tolist()
+
+    def toNumpyMatInplace(self):
+        self._check_dense()
+        return self._arr
+
+    def toNumpyMat(self):
+        self._check_dense()
+        return self._arr.copy()
+
+    copyToNumpyMat = toNumpyMat
+
+    def copyFromNumpyMat(self, data):
+        self._check_dense()
+        a = np.asarray(data, np.float32)
+        if a.shape != self._arr.shape:
+            raise RangeError((a.shape, self._arr.shape))
+        self._arr[...] = a
+
+    def getSparseRowCols(self, i):
+        if self._sparse is None:
+            raise UnsupportError("dense Matrix")
+        indptr, cols, _ = self._sparse
+        if not 0 <= i < self._shape[0]:
+            raise RangeError(i)
+        return cols[indptr[i]:indptr[i + 1]].tolist()
+
+    def getSparseRowColsVal(self, i):
+        if self._sparse is None or self._sparse[2] is None:
+            raise UnsupportError("not a value-sparse Matrix")
+        indptr, cols, vals = self._sparse
+        if not 0 <= i < self._shape[0]:
+            raise RangeError(i)
+        sl = slice(indptr[i], indptr[i + 1])
+        return list(zip(cols[sl].tolist(), vals[sl].tolist()))
+
+    def _check_dense(self):
+        if self._arr is None:
+            raise UnsupportError("sparse Matrix has no dense buffer")
+
+
+class _VectorBase:
+    _dtype = np.float32
+
+    def __init__(self, arr):
+        self._arr = arr
+
+    @classmethod
+    def createZero(cls, sz, useGpu=False):
+        return cls(np.zeros(sz, cls._dtype))
+
+    @classmethod
+    def create(cls, data, useGpu=False):
+        return cls(np.asarray(data, cls._dtype).copy())
+
+    @classmethod
+    def createVectorFromNumpy(cls, data, copy=True, useGpu=False):
+        a = np.asarray(data, cls._dtype)
+        if a.ndim != 1:
+            raise UnsupportError("vector needs a 1-D array")
+        return cls(a.copy() if copy else a)
+
+    @classmethod
+    def createCpuVectorFromNumpy(cls, data, copy=True):
+        return cls.createVectorFromNumpy(data, copy)
+
+    @classmethod
+    def createGpuVectorFromNumpy(cls, data):
+        return cls.createVectorFromNumpy(data, True)
+
+    def copyFrom(self, src):
+        if src.getSize() != self.getSize():
+            raise RangeError((src.getSize(), self.getSize()))
+        self._arr[...] = src._arr
+
+    def toNumpyArrayInplace(self):
+        return self._arr
+
+    def copyToNumpyArray(self):
+        return self._arr.copy()
+
+    def copyFromNumpyArray(self, data):
+        a = np.asarray(data, self._dtype)
+        if a.shape != self._arr.shape:
+            raise RangeError((a.shape, self._arr.shape))
+        self._arr[...] = a
+
+    def get(self, idx):
+        if not 0 <= idx < self._arr.size:
+            raise RangeError(idx)
+        return self._arr[idx].item()
+
+    def set(self, idx, val):
+        if not 0 <= idx < self._arr.size:
+            raise RangeError(idx)
+        self._arr[idx] = val
+
+    def getData(self):
+        return self._arr.tolist()
+
+    def getSize(self):
+        return int(self._arr.size)
+
+    __len__ = getSize
+
+    def isGpu(self):
+        return False
+
+
+class Vector(_VectorBase):
+    """f32 1-D buffer (reference api/PaddleAPI.h:244)."""
+
+
+class IVector(_VectorBase):
+    """int 1-D buffer (reference api/PaddleAPI.h:323)."""
+
+    _dtype = np.int32
+
+
+# ---------------------------------------------------------------------------
+# Parameter surface (reference: api/PaddleAPI.h ParameterConfig:498,
+# Parameter:551, OptimizationConfig:528, ParameterOptimizer:685 +
+# api/Parameter.cpp / ParameterOptimizer.cpp).
+# ---------------------------------------------------------------------------
+
+
+class ParameterConfig:
+    """Proto-shaped view; toProtoString serializes as JSON (the repo's
+    program-as-JSON redesign, PARITY §2.7)."""
+
+    def __init__(self, name, dims):
+        self.name = name
+        self.dims = list(dims)
+
+    def getName(self):
+        return self.name
+
+    def toProtoString(self):
+        import json
+
+        return json.dumps({"name": self.name, "dims": self.dims,
+                           "size": int(np.prod(self.dims))}).encode()
+
+
+class Parameter:
+    """One named parameter over the v2 Parameters scope; getBuf returns
+    a Vector VIEW (mutations write through, the swig contract)."""
+
+    PARAMETER_VALUE = 0
+
+    def __init__(self, v2_parameters, name):
+        self._params = v2_parameters
+        self._name = name
+
+    def getName(self):
+        return self._name
+
+    def getSize(self):
+        return int(np.prod(self._params.get_shape(self._name)))
+
+    def getConfig(self):
+        return ParameterConfig(self._name,
+                               self._params.get_shape(self._name))
+
+    def getBuf(self, which=PARAMETER_VALUE):
+        arr = np.asarray(self._params.get(self._name), np.float32)
+        flat = arr.reshape(-1).copy()
+        v = Vector(flat)
+        v._write_back = lambda: self._params.set(
+            self._name, flat.reshape(arr.shape))
+        return v
+
+    def setBuf(self, vec):
+        shape = self._params.get_shape(self._name)
+        self._params.set(self._name,
+                         np.asarray(vec._arr, np.float32).reshape(shape))
+
+
+class OptimizationConfig:
+    """Holds the optimizer config string consumed by the native C
+    optimizer library (native/src/optimizer.cc; e.g. 'type=sgd lr=0.1'
+    — the reference's OptimizationConfig proto equivalent)."""
+
+    def __init__(self, config_str="type=sgd lr=0.01"):
+        self.config = config_str
+
+    @staticmethod
+    def createFromProtoString(s):
+        return OptimizationConfig(s.decode() if isinstance(s, bytes) else s)
+
+    def toProtoString(self):
+        return self.config.encode()
+
+
+class ParameterOptimizer:
+    """Per-parameter optimizer over the native C-ABI library
+    (reference: api ParameterOptimizer over paddle/parameter
+    optimizers; here native opt_create/opt_update — the same library
+    the parameter server applies updates with)."""
+
+    def __init__(self, opt_config):
+        self._cfg = (opt_config.config
+                     if isinstance(opt_config, OptimizationConfig)
+                     else str(opt_config))
+        self._h = None
+        self._lib = None
+
+    @staticmethod
+    def create(opt_config):
+        return ParameterOptimizer(opt_config)
+
+    def init(self, weights):
+        """Bind initial weights (a Vector, numpy array, or list)."""
+        import ctypes
+
+        from paddle_tpu.native import lib as _native_lib
+
+        w = np.ascontiguousarray(
+            weights._arr if isinstance(weights, _VectorBase) else weights,
+            np.float32)
+        self._lib = _native_lib()
+        self._h = self._lib.opt_create(
+            self._cfg.encode(),
+            w.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), w.size)
+        if not self._h:
+            raise UnsupportError(f"bad optimizer config {self._cfg!r}")
+
+    def update(self, grad):
+        import ctypes
+
+        if self._h is None:
+            raise UnsupportError("init() first")
+        g = np.ascontiguousarray(
+            grad._arr if isinstance(grad, _VectorBase) else grad,
+            np.float32)
+        if self._lib.opt_update(
+                self._h,
+                g.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                g.size) != 0:
+            raise RuntimeError("opt_update failed")
+
+    def getWeights(self):
+        import ctypes
+
+        n = self._lib.opt_weight_count(self._h)
+        out = np.zeros(n, np.float32)
+        self._lib.opt_get_weights(
+            self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n)
+        return Vector(out)
+
+    def __del__(self):
+        if self._h is not None and self._lib is not None:
+            self._lib.opt_destroy(self._h)

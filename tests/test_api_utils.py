@@ -159,3 +159,80 @@ def test_v2_ploter():
     assert p["train"].value == [1.0, 0.5]
     p.reset()
     assert p["train"].value == []
+
+
+def test_swig_matrix_vector_types():
+    """reference api/PaddleAPI.h Matrix:103 / Vector:244 / IVector:323
+    — numpy-backed buffer semantics: inplace views write through,
+    copies do not; range errors; CSR sparse fill."""
+    from paddle_tpu import api
+
+    m = api.Matrix.createDense(list(range(6)), 2, 3)
+    assert (m.getHeight(), m.getWidth()) == (2, 3)
+    assert m.get(1, 2) == 5.0
+    m.set(0, 0, 7.5)
+    assert m.getData()[0] == 7.5
+    view = m.toNumpyMatInplace()
+    view[1, 1] = -1.0
+    assert m.get(1, 1) == -1.0
+    cp = m.copyToNumpyMat()
+    cp[0, 0] = 99.0
+    assert m.get(0, 0) == 7.5  # copy does not write through
+    with pytest.raises(api.RangeError):
+        m.get(5, 0)
+    with pytest.raises(api.UnsupportError):
+        m.getSparseRowCols(0)
+
+    sp = api.Matrix.createSparse(2, 5, 3, isNonVal=False)
+    sp.sparseCopyFrom([0, 2, 3], [1, 4, 0], [0.5, 0.25, -1.0])
+    assert sp.isSparse()
+    assert sp.getSparseRowCols(0) == [1, 4]
+    assert sp.getSparseRowColsVal(1) == [(0, -1.0)]
+
+    v = api.Vector.create([1.0, 2.0, 3.0])
+    v.set(1, 9.0)
+    assert v.getData() == [1.0, 9.0, 3.0]
+    inplace = v.toNumpyArrayInplace()
+    inplace[0] = 4.0
+    assert v.get(0) == 4.0
+    iv = api.IVector.create([3, 1, 2])
+    assert iv.getData() == [3, 1, 2] and iv.getSize() == 3
+    with pytest.raises(api.RangeError):
+        iv.get(3)
+
+
+def test_swig_parameter_and_optimizer():
+    """reference api Parameter:551 / ParameterOptimizer:685 — the i-th
+    parameter wrapper and the native C optimizer behind the swig
+    update contract."""
+    import paddle_tpu.v2 as paddle
+    from paddle_tpu import api
+
+    paddle.init()
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    out = paddle.layer.fc(input=x, size=2, bias_attr=False)
+    cost = paddle.layer.mse_cost(
+        input=out, label=paddle.layer.data(
+            name="y", type=paddle.data_type.dense_vector(2)))
+    gm = api.GradientMachine(cost)
+    assert gm.getParameterSize() >= 1
+    p = gm.getParameter(0)
+    cfg = p.getConfig()
+    assert cfg.getName() == p.getName()
+    assert b"dims" in cfg.toProtoString()
+    buf = p.getBuf(api.Parameter.PARAMETER_VALUE)
+    assert buf.getSize() == p.getSize()
+    with pytest.raises(api.RangeError):
+        gm.getParameter(99)
+
+    # native optimizer: sgd step matches numpy
+    w0 = np.array([1.0, -2.0, 3.0], np.float32)
+    opt = api.ParameterOptimizer.create(
+        api.OptimizationConfig.createFromProtoString(b"type=sgd lr=0.1"))
+    opt.init(api.Vector.create(w0))
+    g = np.array([0.5, 0.25, -1.0], np.float32)
+    opt.update(api.Vector.create(g))
+    np.testing.assert_allclose(opt.getWeights().copyToNumpyArray(),
+                               w0 - 0.1 * g, rtol=1e-6)
+    with pytest.raises(api.UnsupportError):
+        api.ParameterOptimizer.create("type=bogus lr=1").init(w0)
