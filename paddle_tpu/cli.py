@@ -11,6 +11,9 @@ Subcommands:
        binary for the C API; here: re-parse the v1 config, load the
        pass params, export a save_inference_model directory that
        capi/paddle_tpu_capi.h consumes)
+  paddle serve --model_dir=DIR [--port=N]
+      (HTTP JSON inference over a save_inference_model export —
+       paddle_tpu/serving.py)
   paddle pserver [--port=P] [--checkpoint=PATH] [--checkpoint_sec=S]
   paddle master [--port=P] [--lease_sec=S] [--failure_max=N]
   paddle coord  [--port=P]
@@ -107,6 +110,17 @@ def _serve(make_server, argv, label):
     return 0
 
 
+def cmd_serve(argv):
+    """paddle serve --model_dir=DIR [--port=N] — HTTP inference over a
+    save_inference_model export (paddle_tpu/serving.py)."""
+    from paddle_tpu.serving import InferenceServer
+
+    return _serve(
+        lambda a: InferenceServer(a["model_dir"],
+                                  port=int(a.get("port", 0))),
+        argv, "inference server")
+
+
 def cmd_pserver(argv):
     from paddle_tpu.distributed import ParameterServer
 
@@ -138,6 +152,7 @@ COMMANDS = {
     "train": cmd_train,
     "version": cmd_version,
     "merge_model": cmd_merge_model,
+    "serve": cmd_serve,
     "pserver": cmd_pserver,
     "master": cmd_master,
     "coord": cmd_coord,

@@ -1,0 +1,110 @@
+"""HTTP model serving over a save_inference_model export (capability
+extension beyond the 2017 reference, whose deployment story was the C
+API; this serves the same artifact over JSON/HTTP with micro-batched
+execution through the compiling Executor — one XLA program per feed
+signature, so repeated requests hit the compile cache).
+
+Endpoints:
+  GET  /health           → {"status": "ok", "feeds": [...], "fetches": [...]}
+  POST /predict          → body {"<feed>": nested-list, ...}
+                           → {"outputs": [nested-list per fetch]}
+
+Launch:  paddle serve --model_dir=DIR [--port=N]
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+
+class InferenceServer:
+    def __init__(self, model_dir: str, port: int = 0):
+        import paddle_tpu as fluid
+        from paddle_tpu import executor as executor_mod
+
+        self._fluid = fluid
+        self._executor_mod = executor_mod
+        self._scope = executor_mod.Scope()
+        self._exe = fluid.Executor(fluid.TPUPlace())
+        with executor_mod.scope_guard(self._scope):
+            self._program, self.feed_names, self._fetches = (
+                fluid.io.load_inference_model(model_dir, self._exe))
+        self._lock = threading.Lock()  # one executor, serialized steps
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _reply(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._reply(200, {
+                        "status": "ok",
+                        "feeds": server.feed_names,
+                        "fetches": [getattr(f, "name", str(f))
+                                    for f in server._fetches]})
+                else:
+                    self._reply(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._reply(404, {"error": "unknown path"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    outs = server.predict(payload)
+                    self._reply(200, {"outputs": [o.tolist() for o in outs]})
+                except (KeyError, ValueError, TypeError) as e:
+                    self._reply(400, {"error": str(e)})
+                except Exception as e:  # surface, don't kill the server
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self):
+        host, port = self._httpd.server_address[:2]
+        return f"{host}:{port}"
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1]
+
+    def predict(self, payload: dict):
+        feed = {}
+        for name in self.feed_names:
+            if name not in payload:
+                raise KeyError(f"missing feed {name!r}")
+            arr = np.asarray(payload[name])
+            if arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            feed[name] = arr
+        # lengths side-feeds ride along if the client sent them
+        for k, v in payload.items():
+            if k.endswith("@len") and k not in feed:
+                feed[k] = np.asarray(v, np.int64)
+        with self._lock, self._executor_mod.scope_guard(self._scope):
+            outs = self._exe.run(self._program, feed=feed,
+                                 fetch_list=self._fetches)
+        return [np.asarray(o) for o in outs]
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()

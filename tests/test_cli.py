@@ -221,3 +221,57 @@ def test_benchmark_runner_smoke():
     line = [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
     rec = json.loads(line)
     assert rec["model"] == "smallnet" and rec["img_per_sec"] > 0
+
+
+def test_inference_server_serves_model(tmp_path):
+    """paddle serve: HTTP inference over a save_inference_model export
+    (serving.py) — health, predict parity with in-process run, and
+    clean errors for bad requests."""
+    import json
+    import urllib.request
+    import urllib.error
+
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.serving import InferenceServer
+
+    fluid.framework.reset_default_programs()
+    rng = np.random.RandomState(2)
+    x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    d = str(tmp_path / "m")
+    fluid.io.save_inference_model(d, ["x"], [pred], exe)
+    xs = rng.randn(4, 6).astype("float32")
+    (expected,) = exe.run(feed={"x": xs}, fetch_list=[pred])
+
+    srv = InferenceServer(d)
+    try:
+        base = f"http://{srv.address}"
+        with urllib.request.urlopen(f"{base}/health", timeout=10) as r:
+            h = json.loads(r.read())
+        assert h["status"] == "ok" and h["feeds"] == ["x"]
+
+        req = urllib.request.Request(
+            f"{base}/predict",
+            data=json.dumps({"x": xs.tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.loads(r.read())
+        got = np.asarray(out["outputs"][0], np.float32)
+        np.testing.assert_allclose(got, np.asarray(expected), rtol=1e-5,
+                                   atol=1e-6)
+
+        bad = urllib.request.Request(f"{base}/predict", data=b"{}",
+                                     headers={"Content-Type":
+                                              "application/json"})
+        try:
+            urllib.request.urlopen(bad, timeout=10)
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "missing feed" in json.loads(e.read())["error"]
+    finally:
+        srv.stop()
