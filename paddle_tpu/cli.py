@@ -115,6 +115,11 @@ def cmd_serve(argv):
     save_inference_model export (paddle_tpu/serving.py)."""
     from paddle_tpu.serving import InferenceServer
 
+    args, _ = _kv_args(argv)
+    if not args.get("model_dir"):
+        print("usage: paddle serve --model_dir=DIR [--port=N]",
+              file=sys.stderr)
+        return 2
     return _serve(
         lambda a: InferenceServer(a["model_dir"],
                                   port=int(a.get("port", 0))),

@@ -21,6 +21,17 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 
+def _jsonable(o):
+    """Fetch value → JSON shape; LoD outputs become
+    {"data": ..., "lod": [...]} (packed rows + offset tables)."""
+    from paddle_tpu.lod import LoDArray
+
+    if isinstance(o, LoDArray):
+        return {"data": np.asarray(o.data).tolist(),
+                "lod": [np.asarray(l).tolist() for l in o.lod]}
+    return np.asarray(o).tolist()
+
+
 class InferenceServer:
     def __init__(self, model_dir: str, port: int = 0):
         import paddle_tpu as fluid
@@ -67,7 +78,8 @@ class InferenceServer:
                     n = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(n) or b"{}")
                     outs = server.predict(payload)
-                    self._reply(200, {"outputs": [o.tolist() for o in outs]})
+                    self._reply(200, {"outputs": [_jsonable(o)
+                                                  for o in outs]})
                 except (KeyError, ValueError, TypeError) as e:
                     self._reply(400, {"error": str(e)})
                 except Exception as e:  # surface, don't kill the server
@@ -88,22 +100,24 @@ class InferenceServer:
         return self._httpd.server_address[1]
 
     def predict(self, payload: dict):
+        # the executor casts every feed to its declared dtype
+        # (_convert_feed), so raw np.asarray is enough here
         feed = {}
         for name in self.feed_names:
             if name not in payload:
                 raise KeyError(f"missing feed {name!r}")
-            arr = np.asarray(payload[name])
-            if arr.dtype == np.float64:
-                arr = arr.astype(np.float32)
-            feed[name] = arr
+            feed[name] = np.asarray(payload[name])
         # lengths side-feeds ride along if the client sent them
         for k, v in payload.items():
             if k.endswith("@len") and k not in feed:
-                feed[k] = np.asarray(v, np.int64)
-        with self._lock, self._executor_mod.scope_guard(self._scope):
+                feed[k] = np.asarray(v)
+        # pass the scope explicitly: scope_guard would mutate the
+        # process-global scope stack from this handler thread
+        with self._lock:
             outs = self._exe.run(self._program, feed=feed,
-                                 fetch_list=self._fetches)
-        return [np.asarray(o) for o in outs]
+                                 fetch_list=self._fetches,
+                                 scope=self._scope)
+        return list(outs)
 
     def stop(self):
         self._httpd.shutdown()
