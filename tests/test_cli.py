@@ -275,3 +275,54 @@ def test_inference_server_serves_model(tmp_path):
             assert "missing feed" in json.loads(e.read())["error"]
     finally:
         srv.stop()
+
+
+def test_inference_server_sequence_feeds(tmp_path):
+    """Serving a sequence model: padded ids + '<name>@len' side-feeds
+    pass through HTTP and match in-process inference."""
+    import json
+    import urllib.request
+
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.layer_helper import LayerHelper
+    from paddle_tpu.serving import InferenceServer
+
+    fluid.framework.reset_default_programs()
+    vocab, T, E = 20, 5, 8
+    ids = fluid.layers.data(name="word", shape=[-1, -1, 1], dtype="int64",
+                            append_batch_size=False)
+    lens = fluid.layers.data(name="word@len", shape=[1], dtype="int64")
+    emb = fluid.layers.embedding(ids, size=[vocab, E])
+    helper = LayerHelper("padded_sequence_pool")
+    pooled = helper.create_tmp_variable("float32", (-1, E))
+    helper.append_op(type="padded_sequence_pool",
+                     inputs={"X": [emb], "Length": [lens]},
+                     outputs={"Out": [pooled]},
+                     attrs={"pooltype": "MAX"})
+    pred = fluid.layers.fc(input=pooled, size=2, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    d = str(tmp_path / "seq")
+    fluid.io.save_inference_model(d, ["word", "word@len"], [pred], exe)
+
+    xs = np.array([[3, 7, 11, 0, 0], [2, 9, 4, 6, 1]], np.int64)
+    ls = np.array([3, 5], np.int64)
+    (expected,) = exe.run(feed={"word": xs, "word@len": ls},
+                          fetch_list=[pred])
+
+    srv = InferenceServer(d)
+    try:
+        req = urllib.request.Request(
+            f"http://{srv.address}/predict",
+            data=json.dumps({"word": xs.tolist(),
+                             "word@len": ls.tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.loads(r.read())
+        got = np.asarray(out["outputs"][0], np.float32)
+        np.testing.assert_allclose(got, np.asarray(expected), rtol=1e-5,
+                                   atol=1e-6)
+    finally:
+        srv.stop()
