@@ -18,7 +18,10 @@ from paddle_tpu.trainer_config_helpers.layers_extra import *  # noqa: F401,F403 
 from paddle_tpu.trainer_config_helpers.networks import *  # noqa: F401,F403
 from paddle_tpu.trainer_config_helpers.optimizers import *  # noqa: F401,F403
 from paddle_tpu.trainer_config_helpers.data_sources import *  # noqa: F401,F403
+from paddle_tpu.trainer_config_helpers.default_decorators import *  # noqa: F401,F403
 from paddle_tpu.trainer_config_helpers.evaluators import *  # noqa: F401,F403
+from paddle_tpu.trainer_config_helpers.utils import *  # noqa: F401,F403
+from paddle_tpu.trainer_config_helpers import config_parser_utils  # noqa: F401
 
 # operator overloads for LayerOutput + the layer_math namespace
 from paddle_tpu.trainer_config_helpers import layer_math  # noqa: E402,F401

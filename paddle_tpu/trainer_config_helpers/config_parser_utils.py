@@ -1,0 +1,43 @@
+"""Config-parse entry points (reference:
+python/paddle/trainer_config_helpers/config_parser_utils.py — the thin
+functions the v2 topology/layer modules call into the v1 parser
+with)."""
+
+__all__ = [
+    "parse_trainer_config", "parse_network_config",
+    "parse_optimizer_config", "reset_parser",
+]
+
+
+def parse_trainer_config(trainer_conf, config_arg_str=""):
+    from paddle_tpu.trainer import config_parser
+
+    return config_parser.parse_config(trainer_conf, config_arg_str)
+
+
+def parse_network_config(network_conf, config_arg_str=""):
+    """→ the proto-shaped ModelConfigView of the parsed config."""
+    from paddle_tpu.trainer import config_parser
+
+    return config_parser.parse_config(network_conf,
+                                      config_arg_str).model_config
+
+
+def parse_optimizer_config(optimizer_conf, config_arg_str=""):
+    """Run a callable that declares ``settings(...)`` and return the
+    captured optimization settings dict (the repo's OptimizationConfig
+    shape)."""
+    from paddle_tpu.trainer import config_parser
+
+    def conf():
+        optimizer_conf()
+
+    return config_parser.parse_config(conf, config_arg_str).opt_config
+
+
+def reset_parser():
+    """Clear parser/program state between config parses (reference
+    reset_parser → config_parser.begin_parse)."""
+    from paddle_tpu import framework
+
+    framework.reset_default_programs()

@@ -276,3 +276,57 @@ def test_provider_kwargs_forwarded():
 
     rows = list(p(None, limit=5))
     assert len(rows) == 5
+
+
+def test_helper_module_tail():
+    """utils.deprecated / default_decorators / config_parser_utils
+    (reference: trainer_config_helpers/{utils,default_decorators,
+    config_parser_utils}.py)."""
+    import logging
+
+    from paddle_tpu import trainer_config_helpers as tch
+    from paddle_tpu.trainer_config_helpers.config_parser_utils import (
+        parse_network_config, parse_optimizer_config, reset_parser)
+    from paddle_tpu.trainer_config_helpers.default_decorators import (
+        wrap_bias_attr_default, wrap_name_default)
+    from paddle_tpu.trainer_config_helpers.utils import deprecated
+
+    @deprecated("new_thing")
+    def old_thing():
+        return 42
+
+    import io as _io
+    h = logging.StreamHandler(_io.StringIO())
+    logging.getLogger("paddle_tpu.trainer_config_helpers.utils").addHandler(h)
+    assert old_thing() == 42
+
+    @wrap_name_default("mylayer")
+    def make(name=None):
+        return name
+
+    assert make() == "__mylayer_0__"
+    assert make() == "__mylayer_1__"
+    assert make(name="explicit") == "explicit"
+
+    @wrap_bias_attr_default()
+    def biased(bias_attr=None):
+        return bias_attr
+
+    from paddle_tpu.param_attr import ParamAttr
+    assert isinstance(biased(), ParamAttr)      # None -> default attr
+    assert isinstance(biased(bias_attr=True), ParamAttr)
+    assert biased(bias_attr=False) is False     # explicit no-bias kept
+
+    def net():
+        x = tch.data_layer(name="nx", size=4)
+        tch.outputs(tch.fc_layer(input=x, size=2))
+
+    view = parse_network_config(net)
+    assert view.layer("nx")["type"] == "data"
+
+    def opt():
+        tch.settings(batch_size=16, learning_rate=0.5)
+
+    cfg = parse_optimizer_config(opt)
+    assert cfg.get("batch_size") == 16
+    reset_parser()
