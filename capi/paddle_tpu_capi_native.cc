@@ -732,6 +732,9 @@ int RunOp(Machine* m, const Json& op) {
       return Fail("lstm: only default activations in the native path");
     int64_t B = x->dims[0], T = x->dims[1], H4 = x->dims[2], H = H4 / 4;
     bool reverse = AttrNum(op, "is_reverse", 0) != 0;
+    if (reverse && FirstIn(op, "Length"))
+      return Fail("lstm: window-reversed (Length-aware) models need the "
+                  "embedded-Python libpaddle_tpu_capi");
     bool peep = AttrNum(op, "use_peepholes", 0) != 0 && b &&
                 b->numel() == 7 * H;
     const float* bg = b ? b->data.data() : nullptr;            // 4H
@@ -798,6 +801,9 @@ int RunOp(Machine* m, const Json& op) {
       return Fail("gru: only default activations in the native path");
     int64_t B = x->dims[0], T = x->dims[1], H3 = x->dims[2], H = H3 / 3;
     bool reverse = AttrNum(op, "is_reverse", 0) != 0;
+    if (reverse && FirstIn(op, "Length"))
+      return Fail("gru: window-reversed (Length-aware) models need the "
+                  "embedded-Python libpaddle_tpu_capi");
     const float* bias = b ? b->data.data() : nullptr;  // (1, 3H)
     Tensor hid;
     hid.dims = {B, T, H};

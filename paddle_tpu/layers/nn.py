@@ -533,7 +533,7 @@ def lrn(input, n=5, k=2.0, alpha=1e-4, beta=0.75, **kwargs):
 def lstm(input, size: int, h0=None, c0=None, param_attr=None, bias_attr=None,
          use_peepholes: bool = False, is_reverse: bool = False,
          gate_activation="sigmoid", cell_activation="tanh",
-         candidate_activation="tanh", **kwargs):
+         candidate_activation="tanh", lengths=None, **kwargs):
     """Fused LSTM over padded (B, T, 4*size) gate projections; pair with
     an fc(num_flatten_dims=2) for the input projection.  Reference API:
     fluid layers dynamic_lstm (layers/nn.py:134)."""
@@ -553,6 +553,10 @@ def lstm(input, size: int, h0=None, c0=None, param_attr=None, bias_attr=None,
         inputs["H0"] = [h0]
     if c0 is not None:
         inputs["C0"] = [c0]
+    if lengths is not None:
+        # with is_reverse, the op reverses inside each row's valid
+        # window instead of flipping through the padding
+        inputs["Length"] = [lengths]
     helper.append_op(
         type="lstm",
         inputs=inputs,

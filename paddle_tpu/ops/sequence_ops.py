@@ -376,7 +376,7 @@ def _padded_sequence_max_index(ctx):
 
 
 @register_op("lstm",
-             inputs=("Input", "H0", "C0", "Weight", "Bias"),
+             inputs=("Input", "H0", "C0", "Weight", "Bias", "Length"),
              outputs=("Hidden", "Cell", "BatchGate", "BatchCellPreAct"),
              diff_inputs=("Input", "H0", "C0", "Weight", "Bias"))
 def _lstm(ctx):
@@ -474,9 +474,23 @@ def _lstm(ctx):
         c_new = c_new.astype(x.dtype)
         return (h_new, c_new), (h_new, c_new)
 
+    # padded + reversed + lengths known: reverse INSIDE each row's
+    # valid window (the reference's LoD reverse semantics) instead of
+    # flipping the whole padded axis through the padding
+    win_src = None
+    if (ctx.attr("is_reverse", False) and not is_lod
+            and ctx.has_input("Length")):
+        _lens = unwrap(ctx.input("Length")).reshape(-1).astype(jnp.int32)
+        _t = jnp.arange(T, dtype=jnp.int32)
+        win_src = jnp.clip(_lens[:, None] - 1 - _t[None, :], 0, T - 1)
+        _valid = (_t[None, :] < _lens[:, None])
+        x = (jnp.take_along_axis(x, win_src[:, :, None], axis=1)
+             * _valid[:, :, None].astype(x.dtype))
+
     xs = jnp.swapaxes(x, 0, 1)  # (T, B, 4H)
     # LoD input already reverses inside each valid window at pad time
-    whole_reverse = ctx.attr("is_reverse", False) and not is_lod
+    whole_reverse = (ctx.attr("is_reverse", False) and not is_lod
+                     and win_src is None)
     if whole_reverse:
         xs = xs[::-1]
 
@@ -498,6 +512,12 @@ def _lstm(ctx):
         hs, cs = hs[::-1], cs[::-1]
     hidden = jnp.swapaxes(hs, 0, 1)  # (B, T, H)
     cell = jnp.swapaxes(cs, 0, 1)
+    if win_src is not None:
+        # un-reverse: the window map is an involution; re-zero padding
+        hidden = (jnp.take_along_axis(hidden, win_src[:, :, None], axis=1)
+                  * _valid[:, :, None].astype(hidden.dtype))
+        cell = (jnp.take_along_axis(cell, win_src[:, :, None], axis=1)
+                * _valid[:, :, None].astype(cell.dtype))
     if is_lod:
         # re-gather valid steps into packed rows, same lod as the input;
         # under is_reverse padded position p holds original time
@@ -517,7 +537,7 @@ def _lstm(ctx):
 
 
 @register_op("gru",
-             inputs=("Input", "H0", "Weight", "Bias"),
+             inputs=("Input", "H0", "Weight", "Bias", "Length"),
              outputs=("Hidden", "BatchGate", "BatchResetHiddenPrev", "BatchHidden"),
              diff_inputs=("Input", "H0", "Weight", "Bias"))
 def _gru(ctx):
@@ -542,13 +562,25 @@ def _gru(ctx):
         h_new = (u * h + (1 - u) * c).astype(x.dtype)  # stable carry under amp
         return h_new, h_new
 
+    win_src = None
+    if ctx.attr("is_reverse", False) and ctx.has_input("Length"):
+        _lens = unwrap(ctx.input("Length")).reshape(-1).astype(jnp.int32)
+        _t = jnp.arange(T, dtype=jnp.int32)
+        win_src = jnp.clip(_lens[:, None] - 1 - _t[None, :], 0, T - 1)
+        _valid = (_t[None, :] < _lens[:, None])
+        x = (jnp.take_along_axis(x, win_src[:, :, None], axis=1)
+             * _valid[:, :, None].astype(x.dtype))
     xs = jnp.swapaxes(x, 0, 1)
-    if ctx.attr("is_reverse", False):
+    whole_reverse = ctx.attr("is_reverse", False) and win_src is None
+    if whole_reverse:
         xs = xs[::-1]
     _, hs = lax.scan(step, h0, xs)
-    if ctx.attr("is_reverse", False):
+    if whole_reverse:
         hs = hs[::-1]
     hidden = jnp.swapaxes(hs, 0, 1)
+    if win_src is not None:
+        hidden = (jnp.take_along_axis(hidden, win_src[:, :, None], axis=1)
+                  * _valid[:, :, None].astype(hidden.dtype))
     ctx.set_output("Hidden", hidden)
     for slot in ("BatchGate", "BatchResetHiddenPrev", "BatchHidden"):
         if ctx.has_output(slot):
@@ -741,3 +773,25 @@ def _mask_padded_scores(ctx):
     # large-but-finite (not -inf): keeps downstream reductions and
     # central-difference grad checks NaN-free
     ctx.set_output("Out", jnp.where(mask, x, jnp.asarray(-1e30, x.dtype)))
+
+
+@register_op("padded_sequence_reverse", inputs=("X", "Length"))
+def _padded_sequence_reverse(ctx):
+    """Reverse each row of a padded (B, T, ...) tensor inside its valid
+    window (reference: the LoD reverse semantics of reversed recurrent
+    layers — gserver/layers/RecurrentLayer.cpp backward-direction
+    sequence walk).  Without Length, flips the whole time axis.  The
+    map is an involution, so the same op undoes itself."""
+    x = unwrap(ctx.input("X"))
+    T = x.shape[1]
+    if not ctx.has_input("Length"):
+        ctx.set_output("Out", jnp.flip(x, axis=1))
+        return
+    lens = unwrap(ctx.input("Length")).reshape(-1).astype(jnp.int32)
+    t = jnp.arange(T, dtype=jnp.int32)
+    src = jnp.clip(lens[:, None] - 1 - t[None, :], 0, T - 1)  # (B, T)
+    valid = (t[None, :] < lens[:, None])
+    idx = src.reshape(src.shape + (1,) * (x.ndim - 2))
+    out = jnp.take_along_axis(x, idx, axis=1)
+    mask = valid.reshape(valid.shape + (1,) * (x.ndim - 2))
+    ctx.set_output("Out", out * mask.astype(x.dtype))

@@ -384,7 +384,8 @@ def lstmemory(input, size: Optional[int] = None, reverse: bool = False,
 
         assert isinstance(seq, SeqVal)
         h = size if size is not None else (input.size // 4)
-        hidden, _cell = L.lstm(input=seq.var, size=h, is_reverse=reverse)
+        hidden, _cell = L.lstm(input=seq.var, size=h, is_reverse=reverse,
+                               lengths=seq.lengths if reverse else None)
         return SeqVal(hidden, seq.lengths)
 
     return LayerOutput(name or _uname("lstm"), [input], build,
@@ -401,6 +402,8 @@ def gru(input, size: int, reverse: bool = False, name=None,
         w = helper.create_parameter(param_attr, shape=[size, 3 * size],
                                     dtype="float32")
         ins = {"Input": [seq.var], "Weight": [w]}
+        if reverse and seq.lengths is not None:
+            ins["Length"] = [seq.lengths]
         if bias_attr is not False:  # False = no bias, the v1 idiom
             b = helper.create_parameter(bias_attr, shape=[1, 3 * size],
                                         dtype="float32", is_bias=True)
@@ -421,16 +424,30 @@ def simple_rnn(input, size: int, act=None, reverse: bool = False, name=None,
                **kwargs):
     def build(ctx, seq):
         from paddle_tpu import layers as L
+        from paddle_tpu.layer_helper import LayerHelper
 
+        def win_reverse(var):
+            helper = LayerHelper("padded_sequence_reverse")
+            out = helper.create_tmp_variable(var.dtype, var.shape)
+            ins = {"X": [var]}
+            if seq.lengths is not None:
+                ins["Length"] = [seq.lengths]
+            helper.append_op(type="padded_sequence_reverse", inputs=ins,
+                             outputs={"Out": [out]})
+            return out
+
+        src = win_reverse(seq.var) if reverse else seq.var
         rnn = L.StaticRNN()
         with rnn.step():
-            x_t = rnn.step_input(seq.var)
+            x_t = rnn.step_input(src)
             h = rnn.memory(batch_ref=x_t, shape=[-1, size], init_value=0.0)
             nh = L.fc(input=[x_t, h], size=size,
                       act=_act_name(act) or "tanh", bias_attr=True)
             rnn.update_memory(h, nh)
             rnn.step_output(nh)
         (out,) = rnn()
+        if reverse:
+            out = win_reverse(out)  # involution: same map restores order
         return SeqVal(out, seq.lengths)
 
     return LayerOutput(name or _uname("rnn"), [input], build, size=size,

@@ -243,7 +243,8 @@ def test_understand_sentiment_stacked_lstm(rng):
     for i in range(2, stacked_num + 1):
         fc = fluid.layers.fc(input=inputs, size=hid * 4, num_flatten_dims=2)
         lstm, _cell = fluid.layers.dynamic_lstm(
-            input=fc, size=hid, is_reverse=(i % 2) == 0)
+            input=fc, size=hid, is_reverse=(i % 2) == 0,
+            lengths=lens)  # window-correct reversal over ragged rows
         inputs = [fc, lstm]
 
     fc_last = _padded_max_pool(inputs[0], lens)

@@ -400,6 +400,10 @@ SPECS = {
         inputs={"X": [("x", U((2, 4, 3)))],
                 "Length": [("l", np.array([3, 2], np.int64))]},
         attrs={"pooltype": "AVERAGE"}, output_slots=["Out"], wrt=["x"]),
+    "padded_sequence_reverse": lambda: dict(
+        inputs={"X": [("x", U((2, 4, 3)))],
+                "Length": [("l", np.array([3, 2], np.int64))]},
+        attrs={}, output_slots=["Out"], wrt=["x"]),
     "padded_sequence_softmax": lambda: dict(
         inputs={"X": [("x", U((2, 4)))],
                 "Length": [("l", np.array([3, 2], np.int64))]},

@@ -1,0 +1,183 @@
+"""Window-correct reversal for padded recurrent layers (reference:
+reversed recurrent layers walk each SEQUENCE backward —
+gserver/layers/LstmLayer.cpp reversed_ path / RecurrentLayer.cpp — not
+the padded time axis).  With lengths supplied, reverse lstm/gru/rnn on
+padded input must (1) equal the forward run on hand-reversed valid
+windows and (2) be invariant to extra padding columns."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.v2 as paddle
+from paddle_tpu.param_attr import ParamAttr
+from paddle_tpu.v2.inference import Inference
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    fluid.framework.reset_default_programs()
+    paddle.init(use_gpu=False, trainer_count=1)
+    yield
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(17)
+
+
+def _rev_rows(xs, lens):
+    out = np.zeros_like(xs)
+    for b, l in enumerate(lens):
+        out[b, :l] = xs[b, :l][::-1]
+    return out
+
+
+def test_reverse_lstm_matches_forward_on_reversed_windows(rng):
+    """lstm(is_reverse, lengths) == window-unreverse(forward lstm on
+    window-reversed input), with shared weights."""
+    B, T, H = 3, 6, 4
+    lens = np.array([6, 3, 5], np.int64)
+    xs = (rng.randn(B, T, 4 * H) * 0.4).astype("float32")
+    for b, l in enumerate(lens):
+        xs[b, l:] = 0.0
+
+    xp = fluid.layers.data(name="xp", shape=[T, 4 * H], dtype="float32")
+    xr = fluid.layers.data(name="xr", shape=[T, 4 * H], dtype="float32")
+    ln = fluid.layers.data(name="ln", shape=[1], dtype="int64")
+    wa = dict(param_attr=ParamAttr(name="W_shared"),
+              bias_attr=ParamAttr(name="B_shared"))
+    h_rev, _ = fluid.layers.dynamic_lstm(input=xp, size=H, is_reverse=True,
+                                         lengths=ln, **wa)
+    h_fwd, _ = fluid.layers.dynamic_lstm(input=xr, size=H, **wa)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    a, b_ = exe.run(feed={"xp": xs, "xr": _rev_rows(xs, lens),
+                          "ln": lens},
+                    fetch_list=[h_rev, h_fwd])
+    a, b_ = np.asarray(a), np.asarray(b_)
+    for row, l in enumerate(lens):
+        np.testing.assert_allclose(a[row, :l], b_[row, :l][::-1],
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(a[row, l:], 0.0, atol=1e-7)
+
+
+def test_reverse_lstm_padding_invariant(rng):
+    """Extra padding columns must not change valid-region outputs when
+    lengths are supplied (they DO without lengths — the whole-axis flip
+    the padded layout had before)."""
+    B, T, H, extra = 3, 5, 4, 4
+    lens = np.array([5, 2, 4], np.int64)
+    xs = (rng.randn(B, T, 4 * H) * 0.4).astype("float32")
+    for b, l in enumerate(lens):
+        xs[b, l:] = 0.0
+    xs_wide = np.concatenate(
+        [xs, np.zeros((B, extra, 4 * H), "float32")], axis=1)
+
+    def run(x_feed, T_decl):
+        fluid.framework.reset_default_programs()
+        xp = fluid.layers.data(name="xp", shape=[T_decl, 4 * H],
+                               dtype="float32")
+        ln = fluid.layers.data(name="ln", shape=[1], dtype="int64")
+        h, _ = fluid.layers.dynamic_lstm(
+            input=xp, size=H, is_reverse=True, lengths=ln,
+            param_attr=ParamAttr(name="W_pi"),
+            bias_attr=ParamAttr(name="B_pi"))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        (o,) = exe.run(feed={"xp": x_feed, "ln": lens}, fetch_list=[h])
+        return np.asarray(o)
+
+    narrow = run(xs, T)
+    wide = run(xs_wide, T + extra)
+    for row, l in enumerate(lens):
+        np.testing.assert_allclose(wide[row, :l], narrow[row, :l],
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_reverse_gru_matches_forward_on_reversed_windows(rng):
+    from paddle_tpu.layer_helper import LayerHelper
+
+    B, T, H = 3, 5, 4
+    lens = np.array([5, 3, 4], np.int64)
+    xs = (rng.randn(B, T, 3 * H) * 0.4).astype("float32")
+    for b, l in enumerate(lens):
+        xs[b, l:] = 0.0
+
+    def gru_layer(x, ln=None, reverse=False):
+        helper = LayerHelper("gru", param_attr=ParamAttr(name="Wg"),
+                             bias_attr=ParamAttr(name="Bg"))
+        w = helper.create_parameter(ParamAttr(name="Wg"), shape=[H, 3 * H],
+                                    dtype="float32")
+        b = helper.create_parameter(ParamAttr(name="Bg"),
+                                    shape=[1, 3 * H], dtype="float32",
+                                    is_bias=True)
+        hid = helper.create_tmp_variable("float32", (-1, T, H))
+        ins = {"Input": [x], "Weight": [w], "Bias": [b]}
+        if ln is not None:
+            ins["Length"] = [ln]
+        helper.append_op(type="gru", inputs=ins,
+                         outputs={"Hidden": [hid]},
+                         attrs={"is_reverse": reverse})
+        return hid
+
+    xp = fluid.layers.data(name="xp", shape=[T, 3 * H], dtype="float32")
+    xr = fluid.layers.data(name="xr", shape=[T, 3 * H], dtype="float32")
+    ln = fluid.layers.data(name="ln", shape=[1], dtype="int64")
+    h_rev = gru_layer(xp, ln, reverse=True)
+    h_fwd = gru_layer(xr)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    a, b_ = exe.run(feed={"xp": xs, "xr": _rev_rows(xs, lens),
+                          "ln": lens},
+                    fetch_list=[h_rev, h_fwd])
+    a, b_ = np.asarray(a), np.asarray(b_)
+    for row, l in enumerate(lens):
+        np.testing.assert_allclose(a[row, :l], b_[row, :l][::-1],
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_v2_reversed_lstmemory_uses_windows(rng):
+    """The v1/v2 fused lstmemory(reverse=True) path now reverses within
+    each row's window: last_seq of the reversed run must depend only on
+    the valid region (padding-width invariance through the facade)."""
+    D = 8  # = 4 * H with H=2
+    x = paddle.layer.data(
+        name="x", type=paddle.data_type.dense_vector_sequence(D))
+    out = paddle.layer.lstmemory(input=x, reverse=True)
+    first = paddle.layer.first_seq(input=out)
+    params = paddle.parameters.create(first)
+
+    rows = [[[rng.randn(D).astype("float32").tolist()
+              for _ in range(k)]] for k in (5, 2, 4)]
+    got = np.asarray(Inference(first, params).infer(rows))
+
+    # same rows again but fed in a batch whose max length is larger
+    # (an extra long row forces more padding on the short ones)
+    rows_wide = rows + [[[rng.randn(D).astype("float32").tolist()
+                          for _ in range(9)]]]
+    got_wide = np.asarray(Inference(first, params).infer(rows_wide))
+    np.testing.assert_allclose(got_wide[:3], got, rtol=1e-5, atol=1e-6)
+
+
+def test_v2_simple_rnn_reverse_actually_reverses(rng):
+    """recurrent_layer(reverse=True) must differ from forward and be
+    window-correct (it previously ignored ``reverse`` on this path)."""
+    D = 4
+    x = paddle.layer.data(
+        name="x", type=paddle.data_type.dense_vector_sequence(D))
+    fwd = paddle.layer.recurrent(input=x, size=D, name="fw")
+    rev = paddle.layer.recurrent(input=x, size=D, reverse=True, name="bw")
+    cat = paddle.layer.concat(
+        input=[paddle.layer.first_seq(input=fwd),
+               paddle.layer.first_seq(input=rev)])
+    params = paddle.parameters.create(cat)
+    rows = [[[rng.randn(D).astype("float32").tolist()
+              for _ in range(5)]] for _ in range(2)]
+    got = np.asarray(Inference(cat, params).infer(rows))
+    assert got.shape == (2, 2 * D)
+    # the reversed stream's first step is the forward stream's LAST
+    # input processed first — outputs must differ
+    assert not np.allclose(got[:, :D], got[:, D:], atol=1e-5)
