@@ -1386,8 +1386,28 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
         boot_vals = list(vals[k2:])
         lengths = next((v.lengths for v in seq_vals
                         if isinstance(v, (SeqVal, SubSeqVal))), None)
+        # window-correct reverse (the reference walks each SEQUENCE
+        # backward): gather-reverse padded inputs inside their valid
+        # windows, scan forward, un-reverse outputs.  Falls back to the
+        # whole-axis scan reverse when lengths are unknown or inputs
+        # are nested.
+        win_rev = (reverse and lengths is not None
+                   and all(isinstance(v, SeqVal) for v in seq_vals))
+
+        def _wrev(var):
+            from paddle_tpu.layer_helper import LayerHelper
+
+            helper = LayerHelper("padded_sequence_reverse")
+            out_v = helper.create_tmp_variable(var.dtype, var.shape)
+            helper.append_op(type="padded_sequence_reverse",
+                             inputs={"X": [var], "Length": [lengths]},
+                             outputs={"Out": [out_v]})
+            return out_v
+
+        if win_rev:
+            seq_vals = [SeqVal(_wrev(v.var), v.lengths) for v in seq_vals]
         rnn = L.StaticRNN()
-        rnn._reverse = reverse
+        rnn._reverse = reverse and not win_rev
         with rnn.step():
             sub_ctx = {}
             first_in = None
@@ -1457,7 +1477,8 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
         # whole sequence (one big matmul instead of T small ones)
         post_ctx = {}
         for node, r in zip(emit, results):
-            post_ctx[id(node)] = SeqVal(r, lengths)
+            post_ctx[id(node)] = SeqVal(_wrev(r) if win_rev else r,
+                                        lengths)
         for ph, sv in zip(placeholders, seq_vals):
             post_ctx[id(ph)] = sv
         for ph, v in zip(static_phs, static_vals):

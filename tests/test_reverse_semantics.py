@@ -181,3 +181,36 @@ def test_v2_simple_rnn_reverse_actually_reverses(rng):
     # the reversed stream's first step is the forward stream's LAST
     # input processed first — outputs must differ
     assert not np.allclose(got[:, :D], got[:, D:], atol=1e-5)
+
+
+def test_recurrent_group_reverse_window_correct(rng):
+    """recurrent_group(reverse=True) over ragged rows: the reversed
+    group's FIRST emitted step must correspond to each row's LAST valid
+    input (padding-invariant), matching the fused path's window walk."""
+    from paddle_tpu.trainer_config_helpers import (fc_layer, memory,
+                                                   recurrent_group,
+                                                   TanhActivation)
+    import paddle_tpu.v2.layer as v2l
+
+    D = 4
+    x = paddle.layer.data(
+        name="x", type=paddle.data_type.dense_vector_sequence(D))
+
+    def step(x_t):
+        mem = memory(name="hrev", size=D)
+        return fc_layer(input=[x_t, mem], size=D, act=TanhActivation(),
+                        name="hrev", bias_attr=False,
+                        param_attr=ParamAttr(name="Wg1"))
+
+    out = recurrent_group(step=step, input=x, reverse=True)
+    head = paddle.layer.first_seq(input=out)
+    params = paddle.parameters.create(head)
+
+    rows = [[[rng.randn(D).astype("float32").tolist()
+              for _ in range(k)]] for k in (5, 3)]
+    got = np.asarray(Inference(head, params).infer(rows))
+    # pad the batch wider via an extra long row: first two must not move
+    rows_wide = rows + [[[rng.randn(D).astype("float32").tolist()
+                          for _ in range(8)]]]
+    got_wide = np.asarray(Inference(head, params).infer(rows_wide))
+    np.testing.assert_allclose(got_wide[:2], got, rtol=1e-5, atol=1e-6)
