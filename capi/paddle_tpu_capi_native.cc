@@ -605,19 +605,25 @@ int RunOp(Machine* m, const Json& op) {
     int64_t start = static_cast<int64_t>(
         AttrNum(op, "context_start", -(ctx_len / 2)));
     int64_t bsz = x->dims[0], tlen = x->dims[1], d = x->dims[2];
+    // optional Length (B,): windows crossing a short row's end see
+    // zeros, not pad-position values (python twin's Length mask)
+    Tensor* lens = val("Length");
     Tensor out;
     out.dims = {bsz, tlen, d * ctx_len};
     out.data.assign(bsz * tlen * d * ctx_len, 0.f);
-    for (int64_t b = 0; b < bsz; ++b)
+    for (int64_t b = 0; b < bsz; ++b) {
+      int64_t row_end =
+          lens ? static_cast<int64_t>(lens->data[b]) : tlen;
       for (int64_t t = 0; t < tlen; ++t)
         for (int64_t k = 0; k < ctx_len; ++k) {
           int64_t src = t + start + k;
-          if (src < 0 || src >= tlen) continue;
+          if (src < 0 || src >= tlen || src >= row_end) continue;
           const float* sp = &x->data[(b * tlen + src) * d];
           float* dp =
               &out.data[((b * tlen + t) * ctx_len + k) * d];
           std::copy(sp, sp + d, dp);
         }
+    }
     m->values[OutName(op, "Out")] = std::move(out);
     return 0;
   }

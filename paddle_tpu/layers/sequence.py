@@ -55,7 +55,7 @@ def sequence_expand(x, y, **kwargs):
 
 
 def sequence_conv(input, num_filters, filter_size=3, act=None, param_attr=None,
-                  bias_attr=None, **kwargs):
+                  bias_attr=None, lengths=None, **kwargs):
     """Context-window conv over sequence rows (reference:
     operators/sequence_conv_op.cc = context projection + gemm;
     gserver ContextProjection + fc).  input (B, T, D) ->
@@ -70,9 +70,12 @@ def sequence_conv(input, num_filters, filter_size=3, act=None, param_attr=None,
     B, T, D = input.shape
     expanded = helper.create_tmp_variable(input.dtype,
                                           (B, T, D * filter_size))
+    ctx_ins = {"X": [input]}
+    if lengths is not None:
+        ctx_ins["Length"] = [lengths]
     helper.append_op(
         type="context_project",
-        inputs={"X": [input]},
+        inputs=ctx_ins,
         outputs={"Out": [expanded]},
         attrs={"context_length": int(filter_size),
                "context_start": -(int(filter_size) // 2)},

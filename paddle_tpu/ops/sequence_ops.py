@@ -647,14 +647,24 @@ def _expand_to_subseq(ctx):
     ctx.set_output("Out", out)
 
 
-@register_op("context_project", inputs=("X",))
+@register_op("context_project", inputs=("X", "Length"))
 def _context_project(ctx):
     """Sliding-window concat over time (reference: function/
     ContextProjectionOp.cpp; v1 context_projection).  X (B, T, D) ->
     (B, T, D * context_length): position t gets steps
     [t+start, t+start+len) with zero padding past boundaries.  Pure
-    shifts + concat — XLA fuses it into the consumer matmul."""
+    shifts + concat — XLA fuses it into the consumer matmul.
+
+    With Length, steps at or past each row's length are zeroed FIRST,
+    so windows crossing a short row's end see zeros (the reference's
+    sequence-boundary zero padding) instead of pad embeddings."""
     x = unwrap(ctx.input("X"))
+    if ctx.has_input("Length"):
+        _lens = unwrap(ctx.input("Length")).reshape(-1).astype(jnp.int32)
+        _t = jnp.arange(x.shape[1], dtype=jnp.int32)
+        _valid = (_t[None, :] < _lens[:, None])
+        x = x * _valid.reshape(_valid.shape + (1,) * (x.ndim - 2)
+                               ).astype(x.dtype)
     ctx_len = int(ctx.attr("context_length"))
     start = int(ctx.attr("context_start", -(ctx_len // 2)))
     B, T = x.shape[0], x.shape[1]

@@ -651,8 +651,12 @@ def context_projection(input, context_len: int, context_start=None,
     def build(ctx, seq, mixed_size):
         start = context_start if context_start is not None else \
             -(context_len // 2)
-        out = _op("context_project",
-                  {"X": [seq.var if isinstance(seq, SeqVal) else seq]},
+        ins = {"X": [seq.var if isinstance(seq, SeqVal) else seq]}
+        if isinstance(seq, SeqVal) and seq.lengths is not None:
+            # zero the padding first: windows crossing a short row's
+            # end must see zeros, not pad embeddings
+            ins["Length"] = [seq.lengths]
+        out = _op("context_project", ins,
                   attrs={"context_length": context_len,
                          "context_start": start},
                   shape=(-1, -1, (input.size or 0) * context_len))

@@ -214,3 +214,33 @@ def test_recurrent_group_reverse_window_correct(rng):
                           for _ in range(8)]]]
     got_wide = np.asarray(Inference(head, params).infer(rows_wide))
     np.testing.assert_allclose(got_wide[:2], got, rtol=1e-5, atol=1e-6)
+
+
+def test_context_projection_padding_boundary(rng):
+    """Context windows crossing a short row's end must see ZEROS (the
+    reference's sequence-boundary padding), not pad-position values —
+    and outputs must be invariant to extra padding width."""
+    from paddle_tpu.trainer_config_helpers import (context_projection,
+                                                   mixed_layer)
+
+    D = 3
+    x = paddle.layer.data(
+        name="x", type=paddle.data_type.dense_vector_sequence(D))
+    with mixed_layer(size=D * 3) as m:
+        m += context_projection(x, context_len=3)
+    out = m._lo
+    last = paddle.layer.last_seq(input=out)
+    params = paddle.parameters.create(last)
+
+    rows = [[[rng.randn(D).astype("float32").tolist()
+              for _ in range(k)]] for k in (4, 2)]
+    got = np.asarray(Inference(last, params).infer(rows))
+    # wider batch (extra long row -> more padding on the short ones)
+    rows_wide = rows + [[[rng.randn(D).astype("float32").tolist()
+                          for _ in range(7)]]]
+    got_wide = np.asarray(Inference(last, params).infer(rows_wide))
+    np.testing.assert_allclose(got_wide[:2], got, rtol=1e-5, atol=1e-6)
+    # the last valid step's RIGHT context (one past the end) is zero:
+    # its window tail must equal zero block, i.e. the final D entries
+    # of the last step's projection output are exactly 0
+    assert np.allclose(got[:, 2 * D:], 0.0, atol=1e-7), got[:, 2 * D:]
