@@ -376,10 +376,14 @@ def pool2d(input, pool_size=2, pool_type="max", pool_stride=1, pool_padding=0,
 def batch_norm(input, act=None, is_test: bool = False, momentum: float = 0.9,
                epsilon: float = 1e-5, param_attr=None, bias_attr=None,
                data_layout="NCHW", name=None, moving_mean_name=None,
-               moving_variance_name=None, **kwargs):
+               moving_variance_name=None, lengths=None, **kwargs):
     helper = LayerHelper("batch_norm", act=act, name=name, **kwargs)
     dtype = input.dtype
-    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    # padded (B, T, C) sequence frames with lengths: channel is LAST,
+    # statistics run over real frames only (op-side Length mask)
+    seq_frames = lengths is not None and len(input.shape or ()) == 3
+    c = (input.shape[-1] if (seq_frames or data_layout != "NCHW")
+         else input.shape[1])
     scale = helper.create_parameter(
         param_attr, shape=[c], dtype=dtype,
         default_initializer=ConstantInitializer(1.0))
@@ -398,10 +402,13 @@ def batch_norm(input, act=None, is_test: bool = False, momentum: float = 0.9,
     saved_mean = helper.create_tmp_variable("float32", (c,))
     saved_var = helper.create_tmp_variable("float32", (c,))
     out = helper.create_tmp_variable(dtype, input.shape)
+    bn_ins = {"X": [input], "Scale": [scale], "Bias": [bias],
+              "Mean": [mean], "Variance": [variance]}
+    if seq_frames:
+        bn_ins["Length"] = [lengths]
     helper.append_op(
         type="batch_norm",
-        inputs={"X": [input], "Scale": [scale], "Bias": [bias],
-                "Mean": [mean], "Variance": [variance]},
+        inputs=bn_ins,
         outputs={"Y": [out], "MeanOut": [mean], "VarianceOut": [variance],
                  "SavedMean": [saved_mean], "SavedVariance": [saved_var]},
         attrs={"momentum": momentum, "epsilon": epsilon, "is_test": is_test,

@@ -183,7 +183,7 @@ def _pool2d(ctx):
 
 
 @register_op("batch_norm",
-             inputs=("X", "Scale", "Bias", "Mean", "Variance"),
+             inputs=("X", "Scale", "Bias", "Mean", "Variance", "Length"),
              outputs=("Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"),
              diff_inputs=("X", "Scale", "Bias"))
 def _batch_norm(ctx):
@@ -199,7 +199,9 @@ def _batch_norm(ctx):
     momentum = ctx.attr("momentum", 0.9)
     is_test = ctx.attr("is_test", False)
     layout = ctx.attr("data_layout", "NCHW")
-    c_axis = 1 if layout == "NCHW" else x.ndim - 1
+    seq_mode = ctx.has_input("Length") and x.ndim == 3
+    # padded sequence frames (B, T, C): channel is the LAST axis
+    c_axis = (x.ndim - 1 if (seq_mode or layout != "NCHW") else 1)
     red_axes = tuple(i for i in range(x.ndim) if i != c_axis)
     bshape = [1] * x.ndim
     bshape[c_axis] = x.shape[c_axis]
@@ -208,6 +210,21 @@ def _batch_norm(ctx):
         use_mean, use_var = mean, var
         saved_mean, saved_var = mean, var
         new_mean, new_var = mean, var
+    elif seq_mode:
+        # statistics over the REAL frames only (the reference's LoD
+        # rows carry no padding — gserver BatchNormBaseLayer sees
+        # packed frames)
+        _lens = unwrap(ctx.input("Length")).reshape(-1).astype(jnp.int32)
+        _valid = (jnp.arange(x.shape[1])[None, :] < _lens[:, None]
+                  ).astype(jnp.float32)[:, :, None]           # (B, T, 1)
+        n = jnp.maximum(jnp.sum(_valid), 1.0)
+        xf = x.astype(jnp.float32) * _valid
+        use_mean = jnp.sum(xf, axis=(0, 1)) / n
+        use_var = (jnp.sum(jnp.square(xf), axis=(0, 1)) / n
+                   - jnp.square(use_mean))
+        saved_mean, saved_var = use_mean, use_var
+        new_mean = momentum * mean + (1 - momentum) * use_mean
+        new_var = momentum * var + (1 - momentum) * use_var
     else:
         # f32-accumulated statistics regardless of activation dtype (the
         # convert fuses into the reduction, so bf16 activations are read

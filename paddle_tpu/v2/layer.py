@@ -273,6 +273,12 @@ def batch_norm(input, act=None, name=None, **kwargs):
     def build(ctx, x):
         from paddle_tpu import layers as L
 
+        if isinstance(x, SeqVal):
+            # per-frame BN over padded sequences: real frames only
+            out = L.batch_norm(input=x.var, act=_act_name(act),
+                               is_test=bool(ctx.get("@is_test", False)),
+                               lengths=x.lengths)
+            return SeqVal(out, x.lengths)
         return L.batch_norm(input=x, act=_act_name(act),
                             is_test=bool(ctx.get("@is_test", False)))
 

@@ -244,3 +244,40 @@ def test_context_projection_padding_boundary(rng):
     # its window tail must equal zero block, i.e. the final D entries
     # of the last step's projection output are exactly 0
     assert np.allclose(got[:, 2 * D:], 0.0, atol=1e-7), got[:, 2 * D:]
+
+
+def test_batch_norm_masked_sequence_stats(rng):
+    """BN over padded (B, T, C) frames with lengths: training
+    statistics come from REAL frames only (numpy oracle over packed
+    frames) and are padding-width invariant."""
+    B, T, C = 3, 5, 4
+    lens = np.array([5, 2, 4], np.int64)
+    xs = rng.randn(B, T, C).astype("float32")
+    for b, l in enumerate(lens):
+        xs[b, l:] = 7.7  # poison the padding: must not leak into stats
+
+    def run(x_feed, T_decl):
+        fluid.framework.reset_default_programs()
+        xp = fluid.layers.data(name="xp", shape=[T_decl, C],
+                               dtype="float32")
+        ln = fluid.layers.data(name="ln", shape=[1], dtype="int64")
+        y = fluid.layers.batch_norm(input=xp, lengths=ln)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        (o,) = exe.run(feed={"xp": x_feed, "ln": lens}, fetch_list=[y])
+        return np.asarray(o)
+
+    got = run(xs, T)
+    frames = np.concatenate([xs[b, :l] for b, l in enumerate(lens)])
+    mu, var = frames.mean(0), frames.var(0)
+    expect = (frames - mu) / np.sqrt(var + 1e-5)
+    got_frames = np.concatenate([got[b, :l] for b, l in enumerate(lens)])
+    np.testing.assert_allclose(got_frames, expect, rtol=1e-4, atol=1e-5)
+
+    # extra padding width must not move the valid outputs
+    xs_wide = np.concatenate(
+        [xs, np.full((B, 3, C), 7.7, "float32")], axis=1)
+    got_wide = run(xs_wide, T + 3)
+    for b, l in enumerate(lens):
+        np.testing.assert_allclose(got_wide[b, :l], got[b, :l],
+                                   rtol=1e-5, atol=1e-6)
