@@ -698,6 +698,9 @@ int RunOp(Machine* m, const Json& op) {
     return 0;
   }
   if (type == "batch_norm") {  // inference form: running stats
+    if (FirstIn(op, "Length"))
+      return Fail("batch_norm: sequence (Length-aware, channel-last) "
+                  "models need the embedded-Python libpaddle_tpu_capi");
     Tensor* x = val("X");
     Tensor* scale = val("Scale");
     Tensor* bias = val("Bias");

@@ -382,6 +382,10 @@ def batch_norm(input, act=None, is_test: bool = False, momentum: float = 0.9,
     # padded (B, T, C) sequence frames with lengths: channel is LAST,
     # statistics run over real frames only (op-side Length mask)
     seq_frames = lengths is not None and len(input.shape or ()) == 3
+    if lengths is not None and not seq_frames:
+        raise ValueError(
+            "batch_norm(lengths=...) needs a (B, T, C) padded sequence "
+            f"input; got shape {input.shape}")
     c = (input.shape[-1] if (seq_frames or data_layout != "NCHW")
          else input.shape[1])
     scale = helper.create_parameter(

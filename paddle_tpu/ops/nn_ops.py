@@ -237,6 +237,12 @@ def _batch_norm(ctx):
         new_var = momentum * var + (1 - momentum) * use_var
 
     inv = lax.rsqrt(use_var + eps)
+    _seq_valid = None
+    if seq_mode:
+        # preserve the zero-padding invariant downstream ops rely on
+        _lens = unwrap(ctx.input("Length")).reshape(-1).astype(jnp.int32)
+        _seq_valid = (jnp.arange(x.shape[1])[None, :] < _lens[:, None]
+                      )[:, :, None]
     if x.dtype == jnp.bfloat16:
         # normalize in bf16 (stats stay f32): halves the HBM traffic of
         # the normalize pass, measured +6% on the ResNet-50 train step.
@@ -246,11 +252,15 @@ def _batch_norm(ctx):
         b = bias.astype(jnp.float32) - use_mean * a
         y = x * a.astype(x.dtype).reshape(bshape) \
             + b.astype(x.dtype).reshape(bshape)
+        if _seq_valid is not None:
+            y = y * _seq_valid.astype(y.dtype)
         ctx.set_output("Y", y)
     else:
         xf = x.astype(jnp.float32)
         y = (xf - use_mean.reshape(bshape)) * inv.reshape(bshape)
         y = y * scale.reshape(bshape) + bias.reshape(bshape)
+        if _seq_valid is not None:
+            y = y * _seq_valid
         ctx.set_output("Y", y.astype(x.dtype))
     ctx.set_output("MeanOut", new_mean)
     ctx.set_output("VarianceOut", new_var)

@@ -375,6 +375,21 @@ def _padded_sequence_max_index(ctx):
     ctx.set_output("Out", idx.astype(jnp.float32))
 
 
+def _window_reverse(x, lens):
+    """Gather-reverse each row of padded (B, T, ...) inside its valid
+    window; zeros beyond.  Involution: applying twice restores order.
+    → (reversed_x, src_index_map, valid_mask)."""
+    T = x.shape[1]
+    lens = lens.reshape(-1).astype(jnp.int32)
+    t = jnp.arange(T, dtype=jnp.int32)
+    src = jnp.clip(lens[:, None] - 1 - t[None, :], 0, T - 1)   # (B, T)
+    valid = (t[None, :] < lens[:, None])
+    idx = src.reshape(src.shape + (1,) * (x.ndim - 2))
+    mask = valid.reshape(valid.shape + (1,) * (x.ndim - 2))
+    out = jnp.take_along_axis(x, idx, axis=1) * mask.astype(x.dtype)
+    return out, src, valid
+
+
 @register_op("lstm",
              inputs=("Input", "H0", "C0", "Weight", "Bias", "Length"),
              outputs=("Hidden", "Cell", "BatchGate", "BatchCellPreAct"),
@@ -480,12 +495,8 @@ def _lstm(ctx):
     win_src = None
     if (ctx.attr("is_reverse", False) and not is_lod
             and ctx.has_input("Length")):
-        _lens = unwrap(ctx.input("Length")).reshape(-1).astype(jnp.int32)
-        _t = jnp.arange(T, dtype=jnp.int32)
-        win_src = jnp.clip(_lens[:, None] - 1 - _t[None, :], 0, T - 1)
-        _valid = (_t[None, :] < _lens[:, None])
-        x = (jnp.take_along_axis(x, win_src[:, :, None], axis=1)
-             * _valid[:, :, None].astype(x.dtype))
+        _lens_arr = unwrap(ctx.input("Length"))
+        x, win_src, _valid = _window_reverse(x, _lens_arr)
 
     xs = jnp.swapaxes(x, 0, 1)  # (T, B, 4H)
     # LoD input already reverses inside each valid window at pad time
@@ -514,10 +525,8 @@ def _lstm(ctx):
     cell = jnp.swapaxes(cs, 0, 1)
     if win_src is not None:
         # un-reverse: the window map is an involution; re-zero padding
-        hidden = (jnp.take_along_axis(hidden, win_src[:, :, None], axis=1)
-                  * _valid[:, :, None].astype(hidden.dtype))
-        cell = (jnp.take_along_axis(cell, win_src[:, :, None], axis=1)
-                * _valid[:, :, None].astype(cell.dtype))
+        hidden, _, _ = _window_reverse(hidden, _lens_arr)
+        cell, _, _ = _window_reverse(cell, _lens_arr)
     if is_lod:
         # re-gather valid steps into packed rows, same lod as the input;
         # under is_reverse padded position p holds original time
@@ -564,12 +573,8 @@ def _gru(ctx):
 
     win_src = None
     if ctx.attr("is_reverse", False) and ctx.has_input("Length"):
-        _lens = unwrap(ctx.input("Length")).reshape(-1).astype(jnp.int32)
-        _t = jnp.arange(T, dtype=jnp.int32)
-        win_src = jnp.clip(_lens[:, None] - 1 - _t[None, :], 0, T - 1)
-        _valid = (_t[None, :] < _lens[:, None])
-        x = (jnp.take_along_axis(x, win_src[:, :, None], axis=1)
-             * _valid[:, :, None].astype(x.dtype))
+        _lens_arr = unwrap(ctx.input("Length"))
+        x, win_src, _valid = _window_reverse(x, _lens_arr)
     xs = jnp.swapaxes(x, 0, 1)
     whole_reverse = ctx.attr("is_reverse", False) and win_src is None
     if whole_reverse:
@@ -579,8 +584,7 @@ def _gru(ctx):
         hs = hs[::-1]
     hidden = jnp.swapaxes(hs, 0, 1)
     if win_src is not None:
-        hidden = (jnp.take_along_axis(hidden, win_src[:, :, None], axis=1)
-                  * _valid[:, :, None].astype(hidden.dtype))
+        hidden, _, _ = _window_reverse(hidden, _lens_arr)
     ctx.set_output("Hidden", hidden)
     for slot in ("BatchGate", "BatchResetHiddenPrev", "BatchHidden"):
         if ctx.has_output(slot):
@@ -793,15 +797,8 @@ def _padded_sequence_reverse(ctx):
     sequence walk).  Without Length, flips the whole time axis.  The
     map is an involution, so the same op undoes itself."""
     x = unwrap(ctx.input("X"))
-    T = x.shape[1]
     if not ctx.has_input("Length"):
         ctx.set_output("Out", jnp.flip(x, axis=1))
         return
-    lens = unwrap(ctx.input("Length")).reshape(-1).astype(jnp.int32)
-    t = jnp.arange(T, dtype=jnp.int32)
-    src = jnp.clip(lens[:, None] - 1 - t[None, :], 0, T - 1)  # (B, T)
-    valid = (t[None, :] < lens[:, None])
-    idx = src.reshape(src.shape + (1,) * (x.ndim - 2))
-    out = jnp.take_along_axis(x, idx, axis=1)
-    mask = valid.reshape(valid.shape + (1,) * (x.ndim - 2))
-    ctx.set_output("Out", out * mask.astype(x.dtype))
+    out, _, _ = _window_reverse(x, unwrap(ctx.input("Length")))
+    ctx.set_output("Out", out)

@@ -1399,14 +1399,7 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
                    and all(isinstance(v, SeqVal) for v in seq_vals))
 
         def _wrev(var):
-            from paddle_tpu.layer_helper import LayerHelper
-
-            helper = LayerHelper("padded_sequence_reverse")
-            out_v = helper.create_tmp_variable(var.dtype, var.shape)
-            helper.append_op(type="padded_sequence_reverse",
-                             inputs={"X": [var], "Length": [lengths]},
-                             outputs={"Out": [out_v]})
-            return out_v
+            return _v2.append_padded_reverse(var, lengths)
 
         if win_rev:
             seq_vals = [SeqVal(_wrev(v.var), v.lengths) for v in seq_vals]

@@ -426,23 +426,30 @@ def gru(input, size: int, reverse: bool = False, name=None,
                        is_seq=True)
 
 
+def append_padded_reverse(var, lengths=None):
+    """Graph-side window reversal: append a padded_sequence_reverse op
+    over ``var`` (B, T, ...), masking to ``lengths`` when given.  Shared
+    by every builder that needs the reference's backward sequence walk
+    (simple_rnn, recurrent_group)."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    helper = LayerHelper("padded_sequence_reverse")
+    out = helper.create_tmp_variable(var.dtype, var.shape)
+    ins = {"X": [var]}
+    if lengths is not None:
+        ins["Length"] = [lengths]
+    helper.append_op(type="padded_sequence_reverse", inputs=ins,
+                     outputs={"Out": [out]})
+    return out
+
+
 def simple_rnn(input, size: int, act=None, reverse: bool = False, name=None,
                **kwargs):
     def build(ctx, seq):
         from paddle_tpu import layers as L
-        from paddle_tpu.layer_helper import LayerHelper
 
-        def win_reverse(var):
-            helper = LayerHelper("padded_sequence_reverse")
-            out = helper.create_tmp_variable(var.dtype, var.shape)
-            ins = {"X": [var]}
-            if seq.lengths is not None:
-                ins["Length"] = [seq.lengths]
-            helper.append_op(type="padded_sequence_reverse", inputs=ins,
-                             outputs={"Out": [out]})
-            return out
-
-        src = win_reverse(seq.var) if reverse else seq.var
+        src = (append_padded_reverse(seq.var, seq.lengths)
+               if reverse else seq.var)
         rnn = L.StaticRNN()
         with rnn.step():
             x_t = rnn.step_input(src)
@@ -453,7 +460,8 @@ def simple_rnn(input, size: int, act=None, reverse: bool = False, name=None,
             rnn.step_output(nh)
         (out,) = rnn()
         if reverse:
-            out = win_reverse(out)  # involution: same map restores order
+            # involution: the same map restores original order
+            out = append_padded_reverse(out, seq.lengths)
         return SeqVal(out, seq.lengths)
 
     return LayerOutput(name or _uname("rnn"), [input], build, size=size,
