@@ -213,3 +213,74 @@ def test_random_program_dp_mesh_matches_single(seed):
     assert all(np.isfinite(meshed)), (names, meshed)
     np.testing.assert_allclose(meshed, single, rtol=2e-4,
                                err_msg=f"chain {names} seed {seed}")
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_random_sequence_chain_padding_invariant(seed):
+    """Random v1 sequence-layer chains must be padding-width invariant:
+    adding a longer row to the batch (widening everyone's padding) must
+    not move the original rows' pooled outputs.  This is the property
+    the boundary-semantics fixes established op-by-op
+    (tests/test_reverse_semantics.py), held here for compositions."""
+    import paddle_tpu.v2 as paddle
+    from paddle_tpu import trainer_config_helpers as tch
+    from paddle_tpu.v2.inference import Inference
+
+    fluid.framework.reset_default_programs()
+    paddle.init(use_gpu=False, trainer_count=1)
+    rng = np.random.RandomState(5000 + seed)
+    D_seq = 8
+
+    def fc4(x):
+        return tch.fc_layer(input=x, size=D_seq,
+                            act=tch.TanhActivation())
+
+    def lstm_fwd(x):
+        proj = tch.fc_layer(input=x, size=4 * D_seq,
+                            act=tch.LinearActivation())
+        return tch.lstmemory(input=proj)
+
+    def lstm_rev(x):
+        proj = tch.fc_layer(input=x, size=4 * D_seq,
+                            act=tch.LinearActivation())
+        return tch.lstmemory(input=proj, reverse=True)
+
+    def gru_rev(x):
+        proj = tch.fc_layer(input=x, size=3 * D_seq,
+                            act=tch.LinearActivation())
+        return tch.grumemory(input=proj, reverse=True)
+
+    def ctx_win(x):
+        with tch.mixed_layer(size=x.size * 3) as m:
+            m += tch.context_projection(x, context_len=3)
+        lo = m._lo
+        lo.is_seq = True
+        return tch.fc_layer(input=lo, size=D_seq,
+                            act=tch.TanhActivation())
+
+    units = [fc4, lstm_fwd, lstm_rev, gru_rev, ctx_win]
+    def _maxpool(input):
+        return tch.pooling_layer(input=input)
+
+    pools = [tch.last_seq, tch.first_seq, _maxpool]
+
+    x = paddle.layer.data(
+        name="x", type=paddle.data_type.dense_vector_sequence(D_seq))
+    cur, names = x, []
+    for _ in range(rng.randint(2, 4)):
+        u = units[rng.randint(len(units))]
+        names.append(u.__name__)
+        cur = u(cur)
+    pool = pools[rng.randint(len(pools))]
+    head = pool(input=cur)
+    params = paddle.parameters.create(head)
+
+    rows = [[[rng.randn(D_seq).astype("float32").tolist()
+              for _ in range(k)]] for k in (5, 2, 4)]
+    got = np.asarray(Inference(head, params).infer(rows))
+    rows_wide = rows + [[[rng.randn(D_seq).astype("float32").tolist()
+                          for _ in range(9)]]]
+    got_wide = np.asarray(Inference(head, params).infer(rows_wide))
+    np.testing.assert_allclose(
+        got_wide[:3], got, rtol=1e-4, atol=1e-5,
+        err_msg=f"chain {names} (seed {seed}) not padding-invariant")
