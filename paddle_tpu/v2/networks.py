@@ -36,3 +36,16 @@ def stacked_lstm(input, size, depth=2, **kwargs):
     for _ in range(depth):
         x = simple_lstm(x, size)
     return x
+
+
+def __getattr__(name):
+    # the reference v2/networks.py re-exports every
+    # trainer_config_helpers networks composition; natively defined v2
+    # wrappers above win, everything else bridges through (same lazy
+    # pattern as v2.layer's constructor bridge)
+    from paddle_tpu.trainer_config_helpers import networks as _v1n
+
+    if hasattr(_v1n, name):
+        return getattr(_v1n, name)
+    raise AttributeError(
+        f"module 'paddle_tpu.v2.networks' has no attribute {name!r}")

@@ -1,0 +1,173 @@
+"""The full user journey, one flow (integration of every deployment
+surface): train a text classifier through the v2 API, checkpoint and
+reload it, export an inference model, then serve the SAME padded batch
+through four surfaces — in-process executor, reloaded program, the
+HTTP server, and the Python-free C interpreter — and require identical
+probabilities everywhere."""
+
+import io
+import json
+import os
+import subprocess
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.v2 as paddle
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CAPI = os.path.join(REPO, "capi")
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    fluid.framework.reset_default_programs()
+    paddle.init(use_gpu=False, trainer_count=1)
+    yield
+
+
+def test_train_save_reload_serve_c_parity(tmp_path):
+    rng = np.random.RandomState(23)
+    vocab, emb_dim, classes = 30, 16, 2
+
+    # ---- train through the v2 API (reader + SGD trainer) -------------
+    words = paddle.layer.data(
+        name="word", type=paddle.data_type.integer_value_sequence(vocab))
+    emb = paddle.layer.embedding(input=words, size=emb_dim)
+    ctx = paddle.networks.sequence_conv_pool(
+        input=emb, context_len=3, hidden_size=16)
+    pred = paddle.layer.fc(input=ctx, size=classes,
+                           act=paddle.activation.Softmax())
+    label = paddle.layer.data(name="label",
+                              type=paddle.data_type.integer_value(classes))
+    cost = paddle.layer.classification_cost(input=pred, label=label)
+
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.02))
+
+    def sample():
+        l = rng.randint(2, 7)
+        ids = rng.randint(1, vocab, l)
+        y = int(np.sum(ids < vocab // 2) > l / 2)
+        return ids.tolist(), y
+
+    def reader():
+        for _ in range(256):
+            yield sample()
+
+    trainer.train(reader=paddle.batch(reader, batch_size=32),
+                  num_passes=3)
+
+    # ---- checkpoint roundtrip through the Parameters tar -------------
+    buf = io.BytesIO()
+    params.to_tar(buf)
+    buf.seek(0)
+    params2 = paddle.parameters.Parameters(params.topology)
+    params2.init_from_tar(buf)
+    for n in params.keys():
+        np.testing.assert_array_equal(params.get(n), params2.get(n))
+
+    # ---- surface 1: in-process inference over the topology -----------
+    rows = [[[3, 7, 11, 5]], [[3, 7]]]
+    from paddle_tpu.v2.inference import Inference
+
+    inf = Inference(pred, params2)
+    probs_inproc = np.asarray(inf.infer(rows))
+    assert probs_inproc.shape == (2, classes)
+    np.testing.assert_allclose(probs_inproc.sum(1), 1.0, atol=1e-4)
+
+    # ---- export the inference model ----------------------------------
+    export_dir = str(tmp_path / "export")
+    _export_via_executor(inf, export_dir)
+
+    ids = np.array([[3, 7, 11, 5], [3, 7, 0, 0]], np.int64)
+    lens = np.array([4, 2], np.int64)
+
+    # ---- surface 2: reloaded program ---------------------------------
+    import paddle_tpu.executor as executor_mod
+
+    fluid.framework.reset_default_programs()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = executor_mod.Scope()
+    with executor_mod.scope_guard(scope):
+        prog, feeds, fetches = fluid.io.load_inference_model(export_dir,
+                                                             exe)
+        (probs_reload,) = exe.run(prog,
+                                  feed={"word": ids, "word@len": lens},
+                                  fetch_list=fetches)
+    probs_reload = np.asarray(probs_reload)
+    np.testing.assert_allclose(probs_reload, probs_inproc, rtol=1e-5,
+                               atol=1e-6)
+
+    # ---- surface 3: the HTTP server ----------------------------------
+    from paddle_tpu.serving import InferenceServer
+
+    srv = InferenceServer(export_dir)
+    try:
+        req = urllib.request.Request(
+            f"http://{srv.address}/predict",
+            data=json.dumps({"word": ids.tolist(),
+                             "word@len": lens.tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            probs_http = np.asarray(json.loads(r.read())["outputs"][0],
+                                    np.float32)
+    finally:
+        srv.stop()
+    np.testing.assert_allclose(probs_http, probs_inproc, rtol=1e-5,
+                               atol=1e-6)
+
+    # ---- surface 4: the Python-free C interpreter --------------------
+    d = str(tmp_path)
+    lib = os.path.join(d, "libpaddle_tpu_capi_native.so")
+    exe_c = os.path.join(d, "journey_infer")
+    subprocess.run(
+        ["g++", "-std=c++17", "-O2", "-shared", "-fPIC",
+         os.path.join(CAPI, "paddle_tpu_capi_native.cc"), "-o", lib],
+        check=True, capture_output=True)
+    subprocess.run(
+        ["g++", "-O2", os.path.join(CAPI, "examples", "sequence_infer.c"),
+         "-o", exe_c, "-I", CAPI, lib, f"-Wl,-rpath,{d}"],
+        check=True, capture_output=True)
+    ldd = subprocess.run(["ldd", exe_c], capture_output=True, text=True)
+    assert "libpython" not in ldd.stdout
+    env = dict(os.environ)
+    env.pop("PADDLE_TPU_ROOT", None)
+    out = subprocess.run([exe_c, export_dir, "3", "7", "11", "5"],
+                         capture_output=True, text=True, env=env,
+                         timeout=60)
+    assert out.returncode == 0, out.stderr or out.stdout
+    rows_c = [l for l in out.stdout.splitlines() if l.startswith("probs[")]
+    probs_c = np.array([[float(t) for t in r.split(":")[1].split()]
+                        for r in rows_c], np.float32)
+    np.testing.assert_allclose(probs_c, probs_inproc, rtol=1e-4,
+                               atol=1e-5)
+
+    # the classifier actually learned the task
+    acc = 0
+    for _ in range(100):
+        ids_l, y = sample()
+        p = np.asarray(inf.infer([[ids_l]]))
+        acc += int(np.argmax(p[0]) == y)
+    assert acc > 80, acc
+
+
+def _export_via_executor(inf, export_dir):
+    """Export the Inference topology+params as a save_inference_model
+    dir (same layout the trainer's export produces)."""
+    import paddle_tpu.executor as executor_mod
+
+    topo = inf.topology
+    names = []
+    for n, t in topo.feed_types:
+        names.append(n)
+        if getattr(t, "is_seq", False):
+            names.append(n + "@len")
+    with executor_mod.scope_guard(inf.parameters.scope):
+        fluid.io.save_inference_model(export_dir, names,
+                                      topo.output_vars, inf._exe,
+                                      main_program=topo.main_program)

@@ -205,3 +205,13 @@ def test_v2_fluid_path_alias(rng):
     (out,) = exe.run(feed={"xa": rng.randn(3, 4).astype("float32")},
                      fetch_list=[h])
     assert np.asarray(out).shape == (3, 2)
+
+
+def test_v2_networks_bridge():
+    """Every trainer_config_helpers networks composition resolves under
+    paddle.v2.networks (reference v2/networks.py re-exports them)."""
+    from paddle_tpu.trainer_config_helpers import networks as v1n
+
+    missing = [n for n in v1n.__all__
+               if not hasattr(paddle.networks, n)]
+    assert not missing, missing
