@@ -1397,12 +1397,22 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
         # are nested.
         win_rev = (reverse and lengths is not None
                    and all(isinstance(v, SeqVal) for v in seq_vals))
+        # nested groups reverse the ORDER of subsequences (each stays
+        # forward internally) — the same outer-axis window gather
+        win_rev_nested = (reverse and lengths is not None
+                          and all(isinstance(v, SubSeqVal)
+                                  for v in seq_vals))
 
         def _wrev(var):
             return _v2.append_padded_reverse(var, lengths)
 
         if win_rev:
             seq_vals = [SeqVal(_wrev(v.var), v.lengths) for v in seq_vals]
+        elif win_rev_nested:
+            seq_vals = [SubSeqVal(_wrev(v.var), v.lengths,
+                                  _wrev(v.sub_lengths))
+                        for v in seq_vals]
+            win_rev = True  # outputs un-reverse over the outer axis too
         rnn = L.StaticRNN()
         rnn._reverse = reverse and not win_rev
         with rnn.step():

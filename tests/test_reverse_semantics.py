@@ -281,3 +281,52 @@ def test_batch_norm_masked_sequence_stats(rng):
     for b, l in enumerate(lens):
         np.testing.assert_allclose(got_wide[b, :l], got[b, :l],
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_nested_group_reverse_subsequence_order(rng):
+    """recurrent_group(reverse=True) over a NESTED sequence processes
+    subsequences in reverse ORDER, each kept forward internally —
+    padding-count invariant over the outer axis."""
+    from paddle_tpu.trainer_config_helpers import (SubsequenceInput,
+                                                   fc_layer, last_seq,
+                                                   memory,
+                                                   recurrent_group,
+                                                   TanhActivation)
+
+    D = 3
+    x = paddle.layer.data(
+        name="x",
+        type=paddle.data_type.dense_vector_sub_sequence(D))
+
+    def outer_step(sub_seq):
+        # pool each subsequence, feed a running state
+        pooled = last_seq(input=sub_seq)
+        mem = memory(name="nh", size=D)
+        return fc_layer(input=[pooled, mem], size=D,
+                        act=TanhActivation(), name="nh",
+                        bias_attr=False,
+                        param_attr=ParamAttr(name="Wn1"))
+
+    out = recurrent_group(step=outer_step, input=SubsequenceInput(x),
+                          reverse=True)
+    head = paddle.layer.first_seq(input=out)
+    params = paddle.parameters.create(head)
+
+    def mk(subcounts):
+        return [[[[rng.randn(D).astype("float32").tolist()
+                   for _ in range(3)] for _ in range(k)]]
+                for k in subcounts]
+
+    rng2 = np.random.RandomState(31)
+    rows = [[[[rng2.randn(D).astype("float32").tolist()
+               for _ in range(3)] for _ in range(k)]] for k in (3, 2)]
+    got = np.asarray(Inference(head, params).infer(rows))
+    # widen the outer padding with an extra row of 5 subsequences
+    rng3 = np.random.RandomState(31)
+    rows_wide = [[[[rng3.randn(D).astype("float32").tolist()
+                    for _ in range(3)] for _ in range(k)]]
+                 for k in (3, 2)] + \
+        [[[[rng.randn(D).astype("float32").tolist()
+            for _ in range(3)] for _ in range(5)]]]
+    got_wide = np.asarray(Inference(head, params).infer(rows_wide))
+    np.testing.assert_allclose(got_wide[:2], got, rtol=1e-5, atol=1e-6)
