@@ -321,6 +321,26 @@ std::vector<int64_t> AttrPair(const Json& op, const char* key,
   return v;
 }
 
+// Gather-reverse each row of padded (B, T, D) inside its valid window
+// (python twin: ops/sequence_ops.py _window_reverse); zeros beyond.
+// The map is an involution.
+void WindowReverse(const float* x, const float* lens, int64_t B, int64_t T,
+                   int64_t D, float* out) {
+  for (int64_t b = 0; b < B; ++b) {
+    int64_t l = lens ? static_cast<int64_t>(lens[b]) : T;
+    if (l > T) l = T;
+    for (int64_t t = 0; t < T; ++t) {
+      float* dp = out + (b * T + t) * D;
+      if (t < l) {
+        const float* sp = x + (b * T + (l - 1 - t)) * D;
+        std::copy(sp, sp + D, dp);
+      } else {
+        std::fill(dp, dp + D, 0.f);
+      }
+    }
+  }
+}
+
 int RunOp(Machine* m, const Json& op) {
   const std::string type = op.Get("type") ? op.Get("type")->str : "";
   auto val = [&](const char* slot) -> Tensor* {
@@ -741,9 +761,17 @@ int RunOp(Machine* m, const Json& op) {
       return Fail("lstm: only default activations in the native path");
     int64_t B = x->dims[0], T = x->dims[1], H4 = x->dims[2], H = H4 / 4;
     bool reverse = AttrNum(op, "is_reverse", 0) != 0;
-    if (reverse && FirstIn(op, "Length"))
-      return Fail("lstm: window-reversed (Length-aware) models need the "
-                  "embedded-Python libpaddle_tpu_capi");
+    Tensor* seq_lens = val("Length");
+    Tensor x_rev;  // window-reversed input (python twin's Length path)
+    if (reverse && seq_lens) {
+      x_rev.dims = x->dims;
+      x_rev.data.resize(x->numel());
+      WindowReverse(x->data.data(), seq_lens->data.data(), B, T, H4,
+                    x_rev.data.data());
+      x = &x_rev;
+      reverse = false;  // scan forward; outputs un-reverse below
+    }
+    bool win_rev = seq_lens != nullptr && !x_rev.data.empty();
     bool peep = AttrNum(op, "use_peepholes", 0) != 0 && b &&
                 b->numel() == 7 * H;
     const float* bg = b ? b->data.data() : nullptr;            // 4H
@@ -790,6 +818,14 @@ int RunOp(Machine* m, const Json& op) {
         }
       }
     }
+    if (win_rev) {
+      Tensor tmp = hid;
+      WindowReverse(tmp.data.data(), seq_lens->data.data(), B, T, H,
+                    hid.data.data());
+      tmp = cell;
+      WindowReverse(tmp.data.data(), seq_lens->data.data(), B, T, H,
+                    cell.data.data());
+    }
     std::string hname = OutName(op, "Hidden");
     std::string cname = OutName(op, "Cell");
     if (!cname.empty()) m->values[cname] = std::move(cell);
@@ -810,9 +846,17 @@ int RunOp(Machine* m, const Json& op) {
       return Fail("gru: only default activations in the native path");
     int64_t B = x->dims[0], T = x->dims[1], H3 = x->dims[2], H = H3 / 3;
     bool reverse = AttrNum(op, "is_reverse", 0) != 0;
-    if (reverse && FirstIn(op, "Length"))
-      return Fail("gru: window-reversed (Length-aware) models need the "
-                  "embedded-Python libpaddle_tpu_capi");
+    Tensor* seq_lens = val("Length");
+    Tensor x_rev;
+    if (reverse && seq_lens) {
+      x_rev.dims = x->dims;
+      x_rev.data.resize(x->numel());
+      WindowReverse(x->data.data(), seq_lens->data.data(), B, T, H3,
+                    x_rev.data.data());
+      x = &x_rev;
+      reverse = false;
+    }
+    bool win_rev = seq_lens != nullptr && !x_rev.data.empty();
     const float* bias = b ? b->data.data() : nullptr;  // (1, 3H)
     Tensor hid;
     hid.dims = {B, T, H};
@@ -849,6 +893,11 @@ int RunOp(Machine* m, const Json& op) {
           hid.data[(row * T + t) * H + k] = hr[k];
         }
       }
+    }
+    if (win_rev) {
+      Tensor tmp = hid;
+      WindowReverse(tmp.data.data(), seq_lens->data.data(), B, T, H,
+                    hid.data.data());
     }
     m->values[OutName(op, "Hidden")] = std::move(hid);
     return 0;

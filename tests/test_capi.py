@@ -275,7 +275,10 @@ def _save_recurrent_classifier(tmp_path_factory, kind, rng_seed=13):
     if kind.startswith("lstm"):
         proj = fluid.layers.fc(input=emb, size=4 * H, num_flatten_dims=2)
         hidden, _cell = fluid.layers.dynamic_lstm(
-            input=proj, size=H, use_peepholes=(kind == "lstm_peephole"))
+            input=proj, size=H,
+            use_peepholes=(kind == "lstm_peephole"),
+            is_reverse=(kind == "lstm_reverse"),
+            lengths=lens if kind == "lstm_reverse" else None)
     else:
         proj = fluid.layers.fc(input=emb, size=3 * H, num_flatten_dims=2)
         helper = LayerHelper("gru")
@@ -333,7 +336,8 @@ def _save_recurrent_classifier(tmp_path_factory, kind, rng_seed=13):
     return d, np.asarray(expected)
 
 
-@pytest.mark.parametrize("kind", ["lstm", "lstm_peephole", "gru"])
+@pytest.mark.parametrize("kind", ["lstm", "lstm_peephole",
+                                  "lstm_reverse", "gru"])
 def test_native_c_program_runs_recurrent_model(capi_native_binary,
                                                tmp_path_factory, kind):
     """Recurrent inference from pure C: the native interpreter's fused
