@@ -762,7 +762,11 @@ int RunOp(Machine* m, const Json& op) {
     int64_t B = x->dims[0], T = x->dims[1], H4 = x->dims[2], H = H4 / 4;
     bool reverse = AttrNum(op, "is_reverse", 0) != 0;
     Tensor* seq_lens = val("Length");
+    if (reverse && FirstIn(op, "Length") && !seq_lens)
+      return Fail("lstm: reversed model declares Length but none was "
+                  "fed; refusing the whole-axis fallback");
     Tensor x_rev;  // window-reversed input (python twin's Length path)
+    bool win_rev = false;
     if (reverse && seq_lens) {
       x_rev.dims = x->dims;
       x_rev.data.resize(x->numel());
@@ -770,8 +774,8 @@ int RunOp(Machine* m, const Json& op) {
                     x_rev.data.data());
       x = &x_rev;
       reverse = false;  // scan forward; outputs un-reverse below
+      win_rev = true;
     }
-    bool win_rev = seq_lens != nullptr && !x_rev.data.empty();
     bool peep = AttrNum(op, "use_peepholes", 0) != 0 && b &&
                 b->numel() == 7 * H;
     const float* bg = b ? b->data.data() : nullptr;            // 4H
@@ -847,7 +851,11 @@ int RunOp(Machine* m, const Json& op) {
     int64_t B = x->dims[0], T = x->dims[1], H3 = x->dims[2], H = H3 / 3;
     bool reverse = AttrNum(op, "is_reverse", 0) != 0;
     Tensor* seq_lens = val("Length");
+    if (reverse && FirstIn(op, "Length") && !seq_lens)
+      return Fail("gru: reversed model declares Length but none was "
+                  "fed; refusing the whole-axis fallback");
     Tensor x_rev;
+    bool win_rev = false;
     if (reverse && seq_lens) {
       x_rev.dims = x->dims;
       x_rev.data.resize(x->numel());
@@ -855,8 +863,8 @@ int RunOp(Machine* m, const Json& op) {
                     x_rev.data.data());
       x = &x_rev;
       reverse = false;
+      win_rev = true;
     }
-    bool win_rev = seq_lens != nullptr && !x_rev.data.empty();
     const float* bias = b ? b->data.data() : nullptr;  // (1, 3H)
     Tensor hid;
     hid.dims = {B, T, H};

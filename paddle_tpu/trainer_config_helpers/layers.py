@@ -1392,9 +1392,10 @@ def recurrent_group(step, input, reverse=False, name=None, **kwargs):
                         if isinstance(v, (SeqVal, SubSeqVal))), None)
         # window-correct reverse (the reference walks each SEQUENCE
         # backward): gather-reverse padded inputs inside their valid
-        # windows, scan forward, un-reverse outputs.  Falls back to the
-        # whole-axis scan reverse when lengths are unknown or inputs
-        # are nested.
+        # windows, scan forward, un-reverse outputs.  Nested inputs
+        # reverse their outer subsequence order the same way; only
+        # lengths-unknown or mixed SeqVal/SubSeqVal inputs fall back to
+        # the whole-axis scan reverse.
         win_rev = (reverse and lengths is not None
                    and all(isinstance(v, SeqVal) for v in seq_vals))
         # nested groups reverse the ORDER of subsequences (each stays

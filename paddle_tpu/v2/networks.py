@@ -41,11 +41,15 @@ def stacked_lstm(input, size, depth=2, **kwargs):
 def __getattr__(name):
     # the reference v2/networks.py re-exports every
     # trainer_config_helpers networks composition; natively defined v2
-    # wrappers above win, everything else bridges through (same lazy
-    # pattern as v2.layer's constructor bridge)
+    # wrappers above win.  Only the v1 module's PUBLIC __all__ names
+    # bridge — no dunders (forwarding __all__ would hijack this
+    # module's star-import) and no privates.
+    if name.startswith("_"):
+        raise AttributeError(
+            f"module 'paddle_tpu.v2.networks' has no attribute {name!r}")
     from paddle_tpu.trainer_config_helpers import networks as _v1n
 
-    if hasattr(_v1n, name):
+    if name in getattr(_v1n, "__all__", ()):
         return getattr(_v1n, name)
     raise AttributeError(
         f"module 'paddle_tpu.v2.networks' has no attribute {name!r}")

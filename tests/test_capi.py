@@ -287,10 +287,12 @@ def _save_recurrent_classifier(tmp_path_factory, kind, rng_seed=13):
         b = helper.create_parameter(None, shape=[1, 3 * H],
                                     dtype="float32", is_bias=True)
         hidden = helper.create_tmp_variable("float32", (-1, T, H))
-        helper.append_op(type="gru",
-                         inputs={"Input": [proj], "Weight": [w],
-                                 "Bias": [b]},
-                         outputs={"Hidden": [hidden]}, attrs={})
+        gru_ins = {"Input": [proj], "Weight": [w], "Bias": [b]}
+        if kind == "gru_reverse":
+            gru_ins["Length"] = [lens]
+        helper.append_op(type="gru", inputs=gru_ins,
+                         outputs={"Hidden": [hidden]},
+                         attrs={"is_reverse": kind == "gru_reverse"})
     def pool(ptype):
         helper = LayerHelper("padded_sequence_pool")
         out = helper.create_tmp_variable("float32", (-1, H))
@@ -337,7 +339,7 @@ def _save_recurrent_classifier(tmp_path_factory, kind, rng_seed=13):
 
 
 @pytest.mark.parametrize("kind", ["lstm", "lstm_peephole",
-                                  "lstm_reverse", "gru"])
+                                  "lstm_reverse", "gru", "gru_reverse"])
 def test_native_c_program_runs_recurrent_model(capi_native_binary,
                                                tmp_path_factory, kind):
     """Recurrent inference from pure C: the native interpreter's fused

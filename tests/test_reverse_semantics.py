@@ -307,26 +307,47 @@ def test_nested_group_reverse_subsequence_order(rng):
                         bias_attr=False,
                         param_attr=ParamAttr(name="Wn1"))
 
-    out = recurrent_group(step=outer_step, input=SubsequenceInput(x),
-                          reverse=True)
-    head = paddle.layer.first_seq(input=out)
+    out_rev = recurrent_group(step=outer_step, input=SubsequenceInput(x),
+                              reverse=True, name="revgrp")
+
+    # forward twin with SHARED weights: reverse-order semantics means
+    # rev_group(rows).first == fwd_group(rows with subsequences in
+    # reversed ORDER).first
+    def outer_step_fwd(sub_seq):
+        pooled = last_seq(input=sub_seq)
+        mem = memory(name="nh2", size=D)
+        return fc_layer(input=[pooled, mem], size=D,
+                        act=TanhActivation(), name="nh2",
+                        bias_attr=False,
+                        param_attr=ParamAttr(name="Wn1"))
+
+    x2 = paddle.layer.data(
+        name="x2",
+        type=paddle.data_type.dense_vector_sub_sequence(D))
+    out_fwd = recurrent_group(step=outer_step_fwd,
+                              input=SubsequenceInput(x2), name="fwdgrp")
+    # rev[0] is the state after the FULL backward walk == the forward
+    # twin's LAST state over order-reversed subsequences
+    head = paddle.layer.concat(
+        input=[paddle.layer.first_seq(input=out_rev),
+               paddle.layer.last_seq(input=out_fwd)])
     params = paddle.parameters.create(head)
 
-    def mk(subcounts):
-        return [[[[rng.randn(D).astype("float32").tolist()
-                   for _ in range(3)] for _ in range(k)]]
-                for k in subcounts]
+    def infer(rows_a, rows_b):
+        feed = [[a[0], b[0]] for a, b in zip(rows_a, rows_b)]
+        return np.asarray(Inference(head, params).infer(
+            feed, feeding={"x": 0, "x2": 1}))
 
     rng2 = np.random.RandomState(31)
     rows = [[[[rng2.randn(D).astype("float32").tolist()
                for _ in range(3)] for _ in range(k)]] for k in (3, 2)]
-    got = np.asarray(Inference(head, params).infer(rows))
-    # widen the outer padding with an extra row of 5 subsequences
-    rng3 = np.random.RandomState(31)
-    rows_wide = [[[[rng3.randn(D).astype("float32").tolist()
-                    for _ in range(3)] for _ in range(k)]]
-                 for k in (3, 2)] + \
-        [[[[rng.randn(D).astype("float32").tolist()
-            for _ in range(3)] for _ in range(5)]]]
-    got_wide = np.asarray(Inference(head, params).infer(rows_wide))
+    rows_revorder = [[row[0][::-1]] for row in rows]
+    got = infer(rows, rows_revorder)
+    # reversed-ORDER oracle: both halves equal
+    np.testing.assert_allclose(got[:, :D], got[:, D:], rtol=1e-5,
+                               atol=1e-6)
+    # padding-count invariance: widen with an extra 5-subsequence row
+    extra = [[[[rng.randn(D).astype("float32").tolist()
+                for _ in range(3)] for _ in range(5)]]]
+    got_wide = infer(rows + extra, rows_revorder + extra)
     np.testing.assert_allclose(got_wide[:2], got, rtol=1e-5, atol=1e-6)
