@@ -718,9 +718,6 @@ int RunOp(Machine* m, const Json& op) {
     return 0;
   }
   if (type == "batch_norm") {  // inference form: running stats
-    if (FirstIn(op, "Length"))
-      return Fail("batch_norm: sequence (Length-aware, channel-last) "
-                  "models need the embedded-Python libpaddle_tpu_capi");
     Tensor* x = val("X");
     Tensor* scale = val("Scale");
     Tensor* bias = val("Bias");
@@ -728,18 +725,46 @@ int RunOp(Machine* m, const Json& op) {
     Tensor* var = val("Variance");
     if (!x || !scale || !bias || !mean || !var)
       return Fail("batch_norm: missing input");
+    Tensor* seq_lens = val("Length");
+    bool seq_mode = FirstIn(op, "Length") != nullptr;
+    if (seq_mode && !seq_lens)
+      return Fail("batch_norm: sequence model declares Length but none "
+                  "was fed");
+    if (seq_mode && x->dims.size() != 3)
+      return Fail("batch_norm: Length-aware input must be (B, T, C)");
     float eps = static_cast<float>(AttrNum(op, "epsilon", 1e-5));
     int64_t c = scale->numel();
     Tensor out = *x;
-    int64_t inner = 1;  // NCHW: dims after channel axis 1
-    for (size_t i = 2; i < x->dims.size(); ++i) inner *= x->dims[i];
     int64_t n = x->numel();
-    for (int64_t i = 0; i < n; ++i) {
-      int64_t ch = (i / inner) % c;
-      float inv = 1.f / std::sqrt(var->data[ch] + eps);
-      out.data[i] =
-          (x->data[i] - mean->data[ch]) * inv * scale->data[ch] +
-          bias->data[ch];
+    if (seq_mode) {
+      // channel-last (B, T, C) frames; padding rows re-zeroed (python
+      // twin ops/nn_ops.py seq_mode)
+      int64_t B = x->dims[0], T = x->dims[1];
+      for (int64_t b = 0; b < B; ++b) {
+        int64_t l = static_cast<int64_t>(seq_lens->data[b]);
+        for (int64_t t = 0; t < T; ++t)
+          for (int64_t ch = 0; ch < c; ++ch) {
+            int64_t i = (b * T + t) * c + ch;
+            if (t >= l) {
+              out.data[i] = 0.f;
+              continue;
+            }
+            float inv = 1.f / std::sqrt(var->data[ch] + eps);
+            out.data[i] = (x->data[i] - mean->data[ch]) * inv *
+                              scale->data[ch] +
+                          bias->data[ch];
+          }
+      }
+    } else {
+      int64_t inner = 1;  // NCHW: dims after channel axis 1
+      for (size_t i = 2; i < x->dims.size(); ++i) inner *= x->dims[i];
+      for (int64_t i = 0; i < n; ++i) {
+        int64_t ch = (i / inner) % c;
+        float inv = 1.f / std::sqrt(var->data[ch] + eps);
+        out.data[i] =
+            (x->data[i] - mean->data[ch]) * inv * scale->data[ch] +
+            bias->data[ch];
+      }
     }
     m->values[OutName(op, "Y")] = std::move(out);
     return 0;

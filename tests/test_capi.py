@@ -370,3 +370,78 @@ def test_native_c_program_runs_recurrent_model(capi_native_binary,
                     for r in rows], np.float32)
     np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(got.sum(1), 1.0, atol=1e-4)
+
+
+def test_native_c_program_runs_sequence_bn_model(capi_native_binary,
+                                                 tmp_path_factory):
+    """Length-aware (channel-last) batch_norm in the C interpreter:
+    a classifier with per-frame BN trains in Python and serves from
+    pure C with exact parity (running-stats inference form + padding
+    re-zeroed)."""
+    import paddle_tpu as fluid
+    import paddle_tpu.executor as executor_mod
+    from paddle_tpu.layer_helper import LayerHelper
+
+    fluid.framework.reset_default_programs()
+    rng = np.random.RandomState(29)
+    vocab, T, E, classes = 30, 4, 8, 2
+    ids = fluid.layers.data(name="word", shape=[-1, -1, 1], dtype="int64",
+                            append_batch_size=False)
+    lens = fluid.layers.data(name="word@len", shape=[1], dtype="int64")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    emb = fluid.layers.embedding(ids, size=[vocab, E])
+    h = fluid.layers.fc(input=emb, size=E, num_flatten_dims=2)
+    bn = fluid.layers.batch_norm(input=h, lengths=lens)
+    helper = LayerHelper("padded_sequence_pool")
+    pooled = helper.create_tmp_variable("float32", (-1, E))
+    helper.append_op(type="padded_sequence_pool",
+                     inputs={"X": [bn], "Length": [lens]},
+                     outputs={"Out": [pooled]},
+                     attrs={"pooltype": "MAX"})
+    pred = fluid.layers.fc(input=pooled, size=classes, act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=pred, label=label))
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    for _ in range(15):
+        xs = rng.randint(1, vocab, (32, T))
+        ls = rng.randint(1, T + 1, 32)
+        for r in range(32):
+            xs[r, ls[r]:] = 0
+        ys = (xs[:, 0] < vocab // 2).astype(np.int64)
+        exe.run(feed={"word": xs.astype(np.int64),
+                      "word@len": ls.astype(np.int64),
+                      "label": ys.reshape(-1, 1)}, fetch_list=[loss])
+    d = str(tmp_path_factory.mktemp("c_seqbn"))
+    fluid.io.save_inference_model(d, ["word", "word@len"], [pred], exe)
+
+    ids_b = np.array([[3, 7, 11, 5], [3, 7, 0, 0]], np.int64)
+    lens_b = np.array([4, 2], np.int64)
+    fluid.framework.reset_default_programs()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = executor_mod.Scope()
+    with executor_mod.scope_guard(scope):
+        prog, feeds, fetches = fluid.io.load_inference_model(d, exe)
+        (expected,) = exe.run(prog, feed={"word": ids_b,
+                                          "word@len": lens_b},
+                              fetch_list=fetches)
+
+    dd = os.path.dirname(capi_native_binary)
+    exe_c = os.path.join(dd, "seqbn_infer_native")
+    lib = os.path.join(dd, "libpaddle_tpu_capi_native.so")
+    subprocess.run(
+        ["g++", "-O2", os.path.join(CAPI, "examples", "sequence_infer.c"),
+         "-o", exe_c, "-I", CAPI, lib, f"-Wl,-rpath,{dd}"],
+        check=True, capture_output=True)
+    env = dict(os.environ)
+    env.pop("PADDLE_TPU_ROOT", None)
+    out = subprocess.run([exe_c, d, "3", "7", "11", "5"],
+                         capture_output=True, text=True, env=env,
+                         timeout=60)
+    assert out.returncode == 0, out.stderr or out.stdout
+    rows = [l for l in out.stdout.splitlines() if l.startswith("probs[")]
+    got = np.array([[float(t) for t in r.split(":")[1].split()]
+                    for r in rows], np.float32)
+    np.testing.assert_allclose(got, np.asarray(expected), rtol=1e-4,
+                               atol=1e-5)
