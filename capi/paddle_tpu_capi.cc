@@ -70,6 +70,7 @@ class _CapiMachine:
             prog, feeds, fetches = fluid.io.load_inference_model(
                 model_dir, self._exe)
         self._program, self._feed_names, self._fetch_names = prog, feeds, fetches
+        self.model_dir = model_dir  # pd_machine_clone re-opens from here
         self._staged = {}
         self._outputs = []
 
@@ -188,6 +189,23 @@ int pd_machine_create_for_inference(pd_machine* machine,
   auto* m = new Machine();
   m->obj = obj;
   *machine = m;
+  return 0;
+}
+
+int pd_machine_clone(pd_machine src, pd_machine* dst) {
+  // embedded-Python machines serialize on the GIL anyway; a clone is a
+  // fresh shim over the same model_dir held by the source object
+  if (!src) return Fail("null machine");
+  Gil gil;
+  PyObject* md = PyObject_GetAttrString(
+      static_cast<Machine*>(src)->obj, "model_dir");
+  if (!md) return FailFromPython();
+  PyObject* obj = PyObject_CallFunction(g_shim_class, "O", md);
+  Py_DECREF(md);
+  if (!obj) return FailFromPython();
+  auto* m = new Machine();
+  m->obj = obj;
+  *dst = m;
   return 0;
 }
 

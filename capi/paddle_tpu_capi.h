@@ -58,6 +58,13 @@ int pd_machine_feed_i64(pd_machine machine, const char* name,
  * Mirrors paddle_gradient_machine_forward (capi/gradient_machine.h:73). */
 int pd_machine_forward(pd_machine machine);
 
+/* Clone a machine for concurrent use: each clone owns its own
+ * activation state (reference:
+ * capi/examples/model_inference/multi_thread —
+ * paddle_gradient_machine_create_shared_param; here weights are
+ * copied, trading memory for zero cross-thread synchronization). */
+int pd_machine_clone(pd_machine src, pd_machine* dst);
+
 /* Number of fetch targets. */
 int pd_machine_output_count(pd_machine machine);
 

@@ -1018,6 +1018,12 @@ int pd_machine_create_for_inference(pd_machine* machine,
   return 0;
 }
 
+int pd_machine_clone(pd_machine src, pd_machine* dst) {
+  if (!src) return Fail("null machine");
+  *dst = new Machine(*static_cast<Machine*>(src));
+  return 0;
+}
+
 int pd_machine_feed_f32(pd_machine machine, const char* name,
                         const float* data, const int64_t* dims, int ndim) {
   if (!machine) return Fail("null machine");

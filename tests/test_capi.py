@@ -445,3 +445,51 @@ def test_native_c_program_runs_sequence_bn_model(capi_native_binary,
                     for r in rows], np.float32)
     np.testing.assert_allclose(got, np.asarray(expected), rtol=1e-4,
                                atol=1e-5)
+
+
+def test_native_c_multi_thread_inference(capi_native_binary, saved_model):
+    """Concurrent inference via per-thread machine clones (reference:
+    capi/examples/model_inference/multi_thread) — every thread's output
+    must equal that input's single-threaded result."""
+    d = os.path.dirname(capi_native_binary)
+    exe_c = os.path.join(d, "multi_thread_infer")
+    lib = os.path.join(d, "libpaddle_tpu_capi_native.so")
+    subprocess.run(
+        ["g++", "-O2", os.path.join(CAPI, "examples",
+                                    "multi_thread_infer.c"),
+         "-o", exe_c, "-I", CAPI, lib, "-lpthread", f"-Wl,-rpath,{d}"],
+        check=True, capture_output=True)
+    ldd = subprocess.run(["ldd", exe_c], capture_output=True, text=True)
+    assert "libpython" not in ldd.stdout
+
+    model_dir, dim, _ = saved_model
+    env = dict(os.environ)
+    env.pop("PADDLE_TPU_ROOT", None)
+    out = subprocess.run([exe_c, model_dir, str(dim)],
+                         capture_output=True, text=True, env=env,
+                         timeout=60)
+    assert out.returncode == 0, out.stderr or out.stdout
+    lines = [l for l in out.stdout.splitlines()
+             if l.startswith("thread[")]
+    assert len(lines) == 4, out.stdout
+
+    # single-threaded oracle per thread input, via the in-process path
+    import paddle_tpu as fluid
+    import paddle_tpu.executor as executor_mod
+
+    fluid.framework.reset_default_programs()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = executor_mod.Scope()
+    with executor_mod.scope_guard(scope):
+        prog, feeds, fetches = fluid.io.load_inference_model(model_dir,
+                                                             exe)
+        for t, line in enumerate(lines):
+            x = np.array([((i * 31 + t * 7) % 17) / 17.0 - 0.5
+                          for i in range(dim)],
+                         np.float32).reshape(1, dim)
+            (expected,) = exe.run(prog, feed={"x": x},
+                                  fetch_list=fetches)
+            got = np.array([float(v) for v in line.split(":")[1].split()],
+                           np.float32)
+            np.testing.assert_allclose(got, np.asarray(expected).ravel(),
+                                       rtol=1e-4, atol=1e-5)
