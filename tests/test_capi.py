@@ -493,3 +493,82 @@ def test_native_c_multi_thread_inference(capi_native_binary, saved_model):
                            np.float32)
             np.testing.assert_allclose(got, np.asarray(expected).ravel(),
                                        rtol=1e-4, atol=1e-5)
+
+
+def test_native_c_sparse_binary_inference(capi_native_binary,
+                                          tmp_path_factory):
+    """Sparse-binary logistic regression served from C (reference:
+    capi/examples/model_inference/sparse_binary/main.c): the v2
+    sparse_binary_vector feeds densely as multi-hot on the TPU layout;
+    the C caller expands set-bit indices the same way."""
+    import paddle_tpu as fluid
+    import paddle_tpu.v2 as paddle
+    import paddle_tpu.executor as executor_mod
+
+    fluid.framework.reset_default_programs()
+    paddle.init()
+    rng = np.random.RandomState(37)
+    dim, classes = 24, 2
+    x = paddle.layer.data(name="x",
+                          type=paddle.data_type.sparse_binary_vector(dim))
+    pred = paddle.layer.fc(input=x, size=classes,
+                           act=paddle.activation.Softmax())
+    label = paddle.layer.data(name="y",
+                              type=paddle.data_type.integer_value(classes))
+    cost = paddle.layer.classification_cost(input=pred, label=label)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.05))
+
+    def reader():
+        for _ in range(128):
+            bits = rng.choice(dim, rng.randint(1, 6), replace=False)
+            yield bits.tolist(), int(np.sum(bits < dim // 2) >
+                                     len(bits) / 2)
+
+    trainer.train(reader=paddle.batch(reader, batch_size=32),
+                  num_passes=2)
+
+    # export the inference slice
+    d = str(tmp_path_factory.mktemp("c_sparse"))
+    from paddle_tpu.v2.inference import Inference
+
+    inf = Inference(pred, params)
+    topo = inf.topology
+    with executor_mod.scope_guard(params.scope):
+        fluid.io.save_inference_model(d, ["x"], topo.output_vars,
+                                      inf._exe,
+                                      main_program=topo.main_program)
+
+    bits = [1, 5, 20]
+    dense = np.zeros((1, dim), np.float32)
+    dense[0, bits] = 1.0
+    fluid.framework.reset_default_programs()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = executor_mod.Scope()
+    with executor_mod.scope_guard(scope):
+        prog, feeds, fetches = fluid.io.load_inference_model(d, exe)
+        (expected,) = exe.run(prog, feed={"x": dense},
+                              fetch_list=fetches)
+
+    dd = os.path.dirname(capi_native_binary)
+    exe_c = os.path.join(dd, "sparse_binary_infer")
+    lib = os.path.join(dd, "libpaddle_tpu_capi_native.so")
+    subprocess.run(
+        ["g++", "-O2", os.path.join(CAPI, "examples",
+                                    "sparse_binary_infer.c"),
+         "-o", exe_c, "-I", CAPI, lib, f"-Wl,-rpath,{dd}"],
+        check=True, capture_output=True)
+    env = dict(os.environ)
+    env.pop("PADDLE_TPU_ROOT", None)
+    out = subprocess.run(
+        [exe_c, d, str(dim)] + [str(b) for b in bits],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0, out.stderr or out.stdout
+    line = [l for l in out.stdout.splitlines()
+            if l.startswith("probs:")][0]
+    got = np.array([float(t) for t in line.split(":")[1].split()],
+                   np.float32)
+    np.testing.assert_allclose(got, np.asarray(expected).ravel(),
+                               rtol=1e-4, atol=1e-5)
