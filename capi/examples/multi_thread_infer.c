@@ -39,10 +39,13 @@ static void* thread_main(void* p) {
     if (pd_machine_output_dims(job->machine, 0, odims, &nd) == 0) {
       job->out_n = 1;
       for (int i = 0; i < nd; ++i) job->out_n *= odims[i];
-      if (job->out_n <= 64 &&
-          pd_machine_output_f32(job->machine, 0, job->out,
-                                job->out_n) == 0)
+      if (job->out_n > 64) {
+        fprintf(stderr, "thread %d: output too large (%lld > 64)\n",
+                job->tid, (long long)job->out_n);
+      } else if (pd_machine_output_f32(job->machine, 0, job->out,
+                                       job->out_n) == 0) {
         job->rc = 0;
+      }
     }
   }
   free(x);
@@ -54,7 +57,8 @@ int main(int argc, char** argv) {
     fprintf(stderr, "usage: %s <model_dir> <dim>\n", argv[0]);
     return 2;
   }
-  if (pd_init(NULL) != 0) return 1;
+  /* native lib ignores the root; the embedded-Python lib needs it */
+  if (pd_init(getenv("PADDLE_TPU_ROOT")) != 0) return 1;
   pd_machine base;
   if (pd_machine_create_for_inference(&base, argv[1]) != 0) {
     fprintf(stderr, "create failed: %s\n", pd_last_error());

@@ -16,7 +16,9 @@
 
 namespace {
 
-std::string g_last_error;
+// thread_local: concurrent machines (pd_machine_clone) may fail
+// simultaneously; each thread reads its own last error
+thread_local std::string g_last_error;
 PyObject* g_shim_class = nullptr;  // _CapiMachine
 
 struct Machine {

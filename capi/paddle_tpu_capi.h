@@ -61,8 +61,11 @@ int pd_machine_forward(pd_machine machine);
 /* Clone a machine for concurrent use: each clone owns its own
  * activation state (reference:
  * capi/examples/model_inference/multi_thread —
- * paddle_gradient_machine_create_shared_param; here weights are
- * copied, trading memory for zero cross-thread synchronization). */
+ * paddle_gradient_machine_create_shared_param).  The native library
+ * deep-copies the loaded weights (zero cross-thread synchronization);
+ * the embedded-Python library re-opens the source's model_dir, so
+ * there the directory must still exist and be unchanged at clone
+ * time.  pd_last_error() is thread-local. */
 int pd_machine_clone(pd_machine src, pd_machine* dst);
 
 /* Number of fetch targets. */

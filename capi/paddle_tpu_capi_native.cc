@@ -36,7 +36,9 @@
 
 namespace {
 
-std::string g_last_error;
+// thread_local: concurrent machines (pd_machine_clone) may fail
+// simultaneously; each thread reads its own last error
+thread_local std::string g_last_error;
 
 int Fail(const std::string& msg) {
   g_last_error = msg;

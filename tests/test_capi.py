@@ -572,3 +572,50 @@ def test_native_c_sparse_binary_inference(capi_native_binary,
                    np.float32)
     np.testing.assert_allclose(got, np.asarray(expected).ravel(),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_embedded_c_multi_thread_inference(capi_binary, saved_model,
+                                           tmp_path):
+    """pd_machine_clone through the embedded-Python library: the GIL
+    serializes the threads, but per-clone outputs must still match the
+    single-threaded oracle (covers the CPython clone path)."""
+    d = os.path.dirname(capi_binary)
+    exe_c = os.path.join(d, "multi_thread_infer_embedded")
+    lib = os.path.join(d, "libpaddle_tpu_capi.so")
+    ldflags = _pyconfig("--embed", "--ldflags")
+    subprocess.run(
+        ["g++", "-O2", os.path.join(CAPI, "examples",
+                                    "multi_thread_infer.c"),
+         "-o", exe_c, "-I", CAPI, lib, *ldflags, "-lpthread",
+         f"-Wl,-rpath,{d}"],
+        check=True, capture_output=True)
+    model_dir, dim, _ = saved_model
+    env = dict(os.environ)
+    env["PADDLE_TPU_ROOT"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run([exe_c, model_dir, str(dim)],
+                         capture_output=True, text=True, env=env,
+                         timeout=300)
+    assert out.returncode == 0, out.stderr or out.stdout
+    lines = [l for l in out.stdout.splitlines()
+             if l.startswith("thread[")]
+    assert len(lines) == 4, out.stdout
+    import paddle_tpu as fluid
+    import paddle_tpu.executor as executor_mod
+
+    fluid.framework.reset_default_programs()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = executor_mod.Scope()
+    with executor_mod.scope_guard(scope):
+        prog, feeds, fetches = fluid.io.load_inference_model(model_dir,
+                                                             exe)
+        for t, line in enumerate(lines):
+            x = np.array([((i * 31 + t * 7) % 17) / 17.0 - 0.5
+                          for i in range(dim)],
+                         np.float32).reshape(1, dim)
+            (expected,) = exe.run(prog, feed={"x": x},
+                                  fetch_list=fetches)
+            got = np.array([float(v) for v in line.split(":")[1].split()],
+                           np.float32)
+            np.testing.assert_allclose(got, np.asarray(expected).ravel(),
+                                       rtol=1e-4, atol=1e-5)
