@@ -81,10 +81,10 @@ def run(batch=256, image=(3, 224, 224), class_dim=1000, steps=20, warmup=3):
         float(np.asarray(out))
         reps = max(steps // K, 2)
         # chains dispatch asynchronously inside a block (the tunnel RTT
-        # overlaps device work); the best of 3 blocks drops inter-block
+        # overlaps device work); the best of 5 blocks drops inter-block
         # jitter without putting a host sync inside the pipeline
         best, loss_val = float("inf"), 0.0
-        for _ in range(3):
+        for _ in range(5):
             t0 = time.perf_counter()
             for _ in range(reps):
                 out, state = jm(state, dev_feeds)
