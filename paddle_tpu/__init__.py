@@ -56,5 +56,6 @@ from paddle_tpu import net_drawer
 from paddle_tpu import flags
 from paddle_tpu import stat
 from paddle_tpu import errors
+from paddle_tpu import analysis
 
 __version__ = "0.1.0"

@@ -1,0 +1,40 @@
+"""Static program analysis: verifier + lint passes over the Program IR.
+
+The reference pushed every ProgramDesc through C++-side validation
+(InferShape / OpDesc checks) before execution; this package is the
+Python-IR equivalent for the TPU rebuild — a pass manager running
+def-before-use, dtype, fetch-reachability, gradient-pairing, and
+liveness checks over a ``Program`` *before* it burns an XLA compile.
+
+Entry points:
+
+- ``verify_program(program, feed_names, fetch_names, level)`` — run the
+  passes, get structured ``Diagnostic`` records.
+- ``check_or_raise(...)`` — the error-tier gate ``Executor.run`` uses
+  when the ``check_program`` flag is on.
+- ``audit_registry()`` — op-metadata coverage ratchet against the
+  checked-in ``registry_baseline.json``.
+- ``paddle lint <program.json|config.py>`` — the CLI front end.
+"""
+
+from paddle_tpu.analysis.verify import (  # noqa: F401
+    Diagnostic,
+    PassContext,
+    PassInfo,
+    PassManager,
+    ProgramVerificationError,
+    Severity,
+    check_or_raise,
+    default_pass_manager,
+    format_report,
+    register_pass,
+    verify_program,
+)
+from paddle_tpu.analysis import dataflow  # noqa: F401
+from paddle_tpu.analysis import passes  # noqa: F401  (registers passes)
+from paddle_tpu.analysis.registry_audit import (  # noqa: F401
+    audit_registry,
+    current_gaps,
+    load_baseline,
+    write_baseline,
+)

@@ -1,0 +1,135 @@
+"""Def-use and block-walking helpers for the program verifier.
+
+The reference validated programs op-by-op in C++ at desc-build time
+(framework/op_desc.cc InferShape, op_registry.h checks); here the whole
+Program is data, so the analysis layer walks it like a compiler IR:
+per-block def-use chains, recursive descent into Block-valued attrs
+(while / recurrent / conditional_block sub-blocks), and liveness from
+the fetch set.  Everything in this module is read-only over the IR.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from paddle_tpu.framework import Block, Operator, Program
+
+# Executor pseudo-ops: present in pruned/serialized programs, skipped by
+# the compiling executor (executor.py _compile).
+PSEUDO_OPS = frozenset({"feed", "fetch"})
+
+# Ops whose observable effect is host-side I/O, not a dataflow output —
+# liveness must keep them even when nothing reads their outputs.
+SIDE_EFFECT_OPS = frozenset(
+    {"print", "save", "grad_printer", "seq_text_printer"}
+)
+
+# conditional_block's false branch passes through the outputs' prior
+# values (ops/control_flow_ops.py _conditional_block reads outer[n] for
+# every Out), so its outputs are implicit *reads* as well as writes.
+_READS_OWN_OUTPUTS = frozenset({"conditional_block"})
+
+
+def op_reads(op: Operator) -> List[str]:
+    """Non-empty input names, plus op-specific implicit reads."""
+    reads = [n for ns in op.inputs.values() for n in ns if n]
+    if op.type in _READS_OWN_OUTPUTS:
+        reads += [n for n in op.output("Out") if n]
+    return reads
+
+
+def op_writes(op: Operator) -> List[str]:
+    return [n for ns in op.outputs.values() for n in ns if n]
+
+
+def op_sub_blocks(op: Operator) -> List[Tuple[str, Block]]:
+    """Block-valued attrs, i.e. the op's control-flow sub-blocks."""
+    return [(k, v) for k, v in op.attrs.items() if isinstance(v, Block)]
+
+
+def sub_block_bound_names(op: Operator) -> Set[str]:
+    """Names the op binds in the sub-block scope before running it
+    (recurrent injects loop state and per-step input slices; see
+    ops/control_flow_ops.py _recurrent)."""
+    bound: Set[str] = set()
+    for key in ("state_names", "step_input_names"):
+        v = op.attr(key)
+        if isinstance(v, (list, tuple)):
+            bound.update(n for n in v if isinstance(n, str) and n)
+    return bound
+
+
+def block_writes(block: Block, recursive: bool = True,
+                 _seen: Optional[Set[int]] = None) -> Set[str]:
+    """All names written by the block's ops (optionally including
+    nested sub-blocks, whose writes land in the same traced scope)."""
+    _seen = set() if _seen is None else _seen
+    if id(block) in _seen:
+        return set()
+    _seen.add(id(block))
+    out: Set[str] = set()
+    for op in block.ops:
+        out.update(op_writes(op))
+        if recursive:
+            for _, sub in op_sub_blocks(op):
+                out |= block_writes(sub, recursive=True, _seen=_seen)
+    return out
+
+
+def program_writes(program: Program) -> Set[str]:
+    """Every name any op (in any reachable block) writes."""
+    return block_writes(program.global_block(), recursive=True)
+
+
+def walk_ops(block: Block,
+             _seen: Optional[Set[int]] = None
+             ) -> Iterator[Tuple[Block, int, Operator]]:
+    """Yield (block, op_idx, op) for the block and its sub-blocks."""
+    _seen = set() if _seen is None else _seen
+    if id(block) in _seen:
+        return
+    _seen.add(id(block))
+    for idx, op in enumerate(block.ops):
+        yield block, idx, op
+        for _, sub in op_sub_blocks(op):
+            yield from walk_ops(sub, _seen)
+
+
+def implicit_feed_vars(program: Program) -> Set[str]:
+    """Declared, non-persistable variables no op ever writes: the
+    program's input surface (what ``layers.data`` declares).  Used when
+    the caller gives no explicit feed set (lint mode)."""
+    written = program_writes(program)
+    feeds: Set[str] = set()
+    for block in program.blocks:
+        for name, var in block.vars.items():
+            if not var.persistable and name not in written:
+                feeds.add(name)
+    return feeds
+
+
+def declared_dtype(block: Block, name: str) -> Optional[str]:
+    var = block.find_var(name)
+    return var.dtype if var is not None else None
+
+
+def dtype_family(dtype: Optional[str]) -> Optional[str]:
+    if dtype is None:
+        return None
+    if dtype == "bool":
+        return "bool"
+    if dtype.startswith(("float", "bfloat")):
+        return "float"
+    if dtype.startswith(("int", "uint")):
+        return "int"
+    return None
+
+
+def producers(block: Block) -> Dict[str, List[int]]:
+    """name -> ordered list of op indices that write it (this block
+    only; sub-block writes excluded so WAW stays branch-local)."""
+    out: Dict[str, List[int]] = {}
+    for idx, op in enumerate(block.ops):
+        for n in op_writes(op):
+            out.setdefault(n, []).append(idx)
+    return out

@@ -14,6 +14,10 @@ Subcommands:
   paddle serve --model_dir=DIR [--port=N]
       (HTTP JSON inference over a save_inference_model export —
        paddle_tpu/serving.py)
+  paddle lint <program.json|config.py> [--level=...] [--strict] [--json]
+      (static program verification — paddle_tpu/analysis; exits nonzero
+       on error diagnostics.  --audit-registry checks op-metadata
+       coverage against the checked-in baseline)
   paddle pserver [--port=P] [--checkpoint=PATH] [--checkpoint_sec=S]
   paddle master [--port=P] [--lease_sec=S] [--failure_max=N]
   paddle coord  [--port=P]
@@ -153,11 +157,139 @@ def cmd_coord(argv):
                   argv, "coord")
 
 
+def _lint_load(target, config_args=""):
+    """Resolve a lint target to (program, feed_names|None, fetch_names|None).
+
+    ``*.json``: a save_inference_model __model__.json (program + feed/
+    fetch lists) or a bare Program.to_dict dump.  ``*.py``: a v1 trainer
+    config (parsed and traced to a Program via Topology) or a fluid-style
+    script that builds the default main program when exec'd.
+    """
+    import json
+
+    from paddle_tpu import framework
+
+    if target.endswith(".json"):
+        with open(target) as f:
+            meta = json.load(f)
+        if "program" in meta:
+            feeds = meta.get("feed_names")
+            return (framework.Program.from_dict(meta["program"]),
+                    set(feeds) if feeds is not None else None,
+                    meta.get("fetch_names") or None)
+        return framework.Program.from_dict(meta), None, None
+
+    _cwd_importable()
+    v1_err = None
+    try:
+        from paddle_tpu.trainer.config_parser import parse_config
+        from paddle_tpu.v2.topology import Topology
+
+        conf = parse_config(target, config_args)
+        if conf.cost is not None:
+            topo = Topology(conf.cost, extra_layers=conf.evaluators)
+            fetches = [v.name for v in topo.output_vars]
+            return topo.main_program, set(topo.feed_names()), fetches
+    except Exception as e:
+        v1_err = e  # remember; maybe it's a fluid script instead
+    main, startup = framework.Program(), framework.Program()
+    try:
+        with framework.program_guard(main, startup):
+            glb = {"__file__": target, "__name__": "__paddle_lint__"}
+            with open(target) as f:
+                exec(compile(f.read(), target, "exec"), glb)
+    except Exception as e:
+        if v1_err is not None:
+            raise RuntimeError(
+                f"not a v1 config ({type(v1_err).__name__}: {v1_err}) "
+                f"nor a fluid script ({type(e).__name__}: {e})") from e
+        raise
+    if v1_err is not None and not any(b.ops for b in main.blocks):
+        # exec "succeeded" but built nothing: the v1 parse error is the
+        # real diagnostic, not a silent clean
+        raise RuntimeError(
+            f"v1 config parse failed: {type(v1_err).__name__}: {v1_err}")
+    return main, None, None
+
+
+def cmd_lint(argv):
+    """paddle lint <program.json|config.py> [--level=warning] [--strict]
+    [--json] [--fetch=a,b] [--feed=a,b] | paddle lint --audit-registry
+
+    Run the static verifier (paddle_tpu/analysis) and print structured
+    diagnostics.  Exit 1 when errors fire (or warnings, with --strict).
+    """
+    import json as json_mod
+
+    from paddle_tpu import analysis
+
+    args, rest = _kv_args(argv)
+    flags = {a for a in rest if a.startswith("--")}
+    targets = [a for a in rest if not a.startswith("--")]
+    as_json = "--json" in flags
+    strict = "--strict" in flags
+
+    audit = "--audit-registry" in flags or bool(args.get("audit-registry"))
+    diags = []
+    if audit:
+        diags.extend(analysis.audit_registry())
+    if not targets and not audit:
+        print("usage: paddle lint <program.json|config.py> "
+              "[--level=error|warning|all] [--strict] [--json] "
+              "[--fetch=a,b] [--feed=a,b] [--audit-registry]",
+              file=sys.stderr)
+        return 2
+
+    level = args.get("level", "warning")
+    if level not in ("error", "warning", "info", "all"):
+        print(f"bad --level={level}; one of error|warning|info|all",
+              file=sys.stderr)
+        return 2
+    unusable = False  # a bad target never downgrades to "clean"
+    for target in targets:
+        if not os.path.exists(target):
+            print(f"lint target not found: {target}", file=sys.stderr)
+            unusable = True
+            continue
+        try:
+            program, feeds, fetches = _lint_load(target,
+                                                 args.get("config_args", ""))
+        except Exception as e:
+            print(f"cannot load lint target {target}: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            unusable = True
+            continue
+        if not any(b.ops for b in program.blocks):
+            # a target that builds zero ops was not actually analyzed —
+            # reporting "clean" here would be a false negative
+            print(f"lint target {target} built an empty program "
+                  "(no ops); nothing to analyze", file=sys.stderr)
+            unusable = True
+            continue
+        if args.get("feed"):
+            feeds = set(args["feed"].split(","))
+        if args.get("fetch"):
+            fetches = args["fetch"].split(",")
+        diags.extend(analysis.verify_program(
+            program, feed_names=feeds, fetch_names=fetches, level=level))
+
+    if as_json:
+        print(json_mod.dumps([d.to_dict() for d in diags], indent=1))
+    elif diags or not unusable:  # no "clean" claim if nothing was analyzed
+        print(analysis.format_report(diags))
+    if unusable:
+        return 2
+    bad = [d for d in diags if d.severity == analysis.Severity.ERROR
+           or (strict and d.severity == analysis.Severity.WARNING)]
+    return 1 if bad else 0
+
+
 COMMANDS = {
     "train": cmd_train,
     "version": cmd_version,
     "merge_model": cmd_merge_model,
     "serve": cmd_serve,
+    "lint": cmd_lint,
     "pserver": cmd_pserver,
     "master": cmd_master,
     "coord": cmd_coord,

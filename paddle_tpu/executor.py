@@ -247,6 +247,13 @@ class Executor:
         )
         compiled = self._cache.get(key)
         if compiled is None:
+            # Pre-compile static checks (paddle_tpu/analysis).  The fetch
+            # check always runs — fetching a never-written variable must
+            # name the variable up front, not die as a KeyError mid-trace.
+            # With the check_program flag on, the full error tier runs
+            # (def-before-use, dtype clash, bad sub-blocks, ...) before
+            # any JAX tracing.  Cache hits skip both: already vetted.
+            self._verify(program, feed_vals, fetch_names)
             compiled = self._compile(program, feed_vals, fetch_names, scope)
             self._cache[key] = compiled
 
@@ -286,6 +293,29 @@ class Executor:
         return out
 
     # -- internals ----------------------------------------------------------
+
+    @staticmethod
+    def _verify(program: Program, feed_vals: Dict[str, Any],
+                fetch_names: Sequence[str]):
+        from paddle_tpu import analysis
+        from paddle_tpu.flags import FLAGS
+
+        if FLAGS.get("check_program"):
+            analysis.check_or_raise(
+                program, feed_names=set(feed_vals), fetch_names=fetch_names,
+                header="program rejected before compile "
+                       "(flag check_program=1)")
+            return
+        # flag off: still catch the cheapest, most opaque failure mode —
+        # a fetch target nothing writes — with a clear error
+        diags = analysis.verify_program(
+            program, feed_names=set(feed_vals), fetch_names=fetch_names,
+            only=("fetch-reachability",))
+        if diags:
+            raise RuntimeError(
+                "; ".join(d.message for d in diags)
+                + " — run with flags check_program=1 for full program "
+                  "verification")
 
     def _seed_for_step(self, program: Program) -> int:
         base = program.seed if program.seed is not None else 0

@@ -52,6 +52,14 @@ FLAGS.define("num_passes", 1, "training passes")
 FLAGS.define("parallel_nn", False, "model-parallel layer placement")
 FLAGS.define("port", 20134, "pserver base port")
 FLAGS.define("num_gradient_servers", 1, "sync-SGD barrier width")
+# TPU-era addition: run the static verifier (paddle_tpu/analysis) over a
+# program on every compile-cache miss, turning mid-trace KeyErrors into
+# structured diagnostics before any XLA work.  The PADDLE_CHECK_PROGRAM
+# env var seeds the default so the gate works without touching code.
+FLAGS.define("check_program",
+             os.environ.get("PADDLE_CHECK_PROGRAM", "").lower()
+             in ("1", "true", "yes"),
+             "verify programs before compiling (error-tier analysis passes)")
 
 
 def init_gflags(argv):

@@ -504,6 +504,49 @@ class Program:
 
     __str__ = to_string
 
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Program":
+        """Rebuild a Program from ``to_dict`` output (the protobuf-free
+        wire format used by save_inference_model's __model__.json and
+        ``paddle lint <program.json>``)."""
+        p = Program.__new__(Program)
+        p.blocks = []
+        p.current_block_idx = 0
+        p.seed = d.get("seed")
+        p._version = 0
+        for bd in d["blocks"]:
+            b = Block(p, bd["idx"], bd["parent_idx"])
+            p.blocks.append(b)
+        for bd, b in zip(d["blocks"], p.blocks):
+            for name, vd in bd["vars"].items():
+                if vd.get("is_parameter"):
+                    var = Parameter(b, vd["shape"], vd["dtype"], name=name)
+                else:
+                    var = Variable(
+                        b, name=name, shape=vd["shape"], dtype=vd["dtype"],
+                        lod_level=vd.get("lod_level", 0),
+                        persistable=vd.get("persistable", False),
+                        stop_gradient=vd.get("stop_gradient", False))
+                b.vars[name] = var
+            for od in bd["ops"]:
+                attrs = {}
+                for k, v in od["attrs"].items():
+                    if isinstance(v, dict) and "__block__" in v:
+                        v = p.blocks[v["__block__"]]
+                    elif isinstance(v, dict) and "__ndarray__" in v:
+                        v = np.asarray(v["__ndarray__"], dtype=v["dtype"])
+                    attrs[k] = v
+                op = Operator.__new__(Operator)
+                op.block = b
+                op.type = od["type"]
+                op.inputs = {k: list(v) for k, v in od["inputs"].items()}
+                op.outputs = {k: list(v) for k, v in od["outputs"].items()}
+                # _AttrDict so in-place attr edits on a LOADED program
+                # also version-bump the executor's compile-cache key
+                op.attrs = _AttrDict(op, attrs)
+                b.ops.append(op)
+        return p
+
     def fingerprint(self) -> str:
         """Stable content hash; the compile-cache key component."""
         blob = json.dumps(self.to_dict(), sort_keys=True, default=str)

@@ -158,44 +158,9 @@ def load_inference_model(dirname: str, executor):
 
 
 def _program_from_dict(d) -> Program:
-    from paddle_tpu.framework import Block, Operator, Parameter, Variable
-
-    p = Program.__new__(Program)
-    p.blocks = []
-    p.current_block_idx = 0
-    p.seed = d.get("seed")
-    for bd in d["blocks"]:
-        b = Block(p, bd["idx"], bd["parent_idx"])
-        p.blocks.append(b)
-    for bd, b in zip(d["blocks"], p.blocks):
-        for name, vd in bd["vars"].items():
-            cls = Parameter if vd.get("is_parameter") else Variable
-            if cls is Parameter:
-                var = Parameter(b, vd["shape"], vd["dtype"], name=name)
-            else:
-                var = Variable(b, name=name, shape=vd["shape"], dtype=vd["dtype"],
-                               lod_level=vd.get("lod_level", 0),
-                               persistable=vd.get("persistable", False),
-                               stop_gradient=vd.get("stop_gradient", False))
-            b.vars[name] = var
-        for od in bd["ops"]:
-            attrs = {}
-            for k, v in od["attrs"].items():
-                if isinstance(v, dict) and "__block__" in v:
-                    v = p.blocks[v["__block__"]]
-                elif isinstance(v, dict) and "__ndarray__" in v:
-                    v = np.asarray(v["__ndarray__"], dtype=v["dtype"])
-                attrs[k] = v
-            op = Operator.__new__(Operator)
-            op.block = b
-            op.type = od["type"]
-            op.inputs = {k: list(v) for k, v in od["inputs"].items()}
-            op.outputs = {k: list(v) for k, v in od["outputs"].items()}
-            # _AttrDict so in-place attr edits on a LOADED program also
-            # version-bump the executor's compile-cache key
-            op.attrs = framework._AttrDict(op, attrs)
-            b.ops.append(op)
-    return p
+    # implementation moved to framework.Program.from_dict so the lint
+    # CLI and analysis passes can load programs without importing io
+    return Program.from_dict(d)
 
 
 # ---------------------------------------------------------------------------

@@ -8,11 +8,11 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.ops.common import unary
-from paddle_tpu.registry import register_op
+from paddle_tpu.registry import infer_same_shape, register_op
 
 
 def _reg(name, fn):
-    @register_op(name, inputs=("X",))
+    @register_op(name, inputs=("X",), infer_shape=infer_same_shape)
     def _act(ctx, fn=fn):
         unary(ctx, lambda x: _apply(ctx, fn, x))
 
@@ -70,7 +70,7 @@ for _n, _f in _WITH_ATTRS.items():
     _reg(_n, _f)
 
 
-@register_op("prelu", inputs=("X", "Alpha"))
+@register_op("prelu", inputs=("X", "Alpha"), infer_shape=infer_same_shape)
 def _prelu(ctx):
     from paddle_tpu.lod import rewrap, unwrap
 

@@ -12,7 +12,7 @@ import jax.numpy as jnp
 
 from paddle_tpu.lod import LoDArray, rewrap, unwrap
 from paddle_tpu.ops.common import jnp_dtype, unary
-from paddle_tpu.registry import register_op
+from paddle_tpu.registry import infer_same_shape, register_op
 
 
 @register_op("fill_constant", inputs=(), stop_gradient=True)
@@ -34,17 +34,17 @@ def _fill_constant_bsl(ctx):
     ctx.set_output("Out", jnp.full(tuple(shape), ctx.attr("value", 0.0), dtype=dtype))
 
 
-@register_op("fill_zeros_like", inputs=("X",), stop_gradient=True)
+@register_op("fill_zeros_like", inputs=("X",), stop_gradient=True, infer_shape=infer_same_shape)
 def _fill_zeros_like(ctx):
     unary(ctx, jnp.zeros_like)
 
 
-@register_op("assign", inputs=("X",))
+@register_op("assign", inputs=("X",), infer_shape=infer_same_shape)
 def _assign(ctx):
     ctx.set_output("Out", ctx.input("X"))
 
 
-@register_op("cast", inputs=("X",))
+@register_op("cast", inputs=("X",), infer_shape=infer_same_shape)
 def _cast(ctx):
     dtype = jnp_dtype(ctx.attr("out_dtype", ctx.attr("dtype", "float32")))
     unary(ctx, lambda x: x.astype(dtype))
@@ -70,7 +70,7 @@ def _gaussian_random(ctx):
     ctx.set_output("Out", (jax.random.normal(key, shape) * std + mean).astype(dtype))
 
 
-@register_op("increment", inputs=("X",), stop_gradient=True)
+@register_op("increment", inputs=("X",), stop_gradient=True, infer_shape=infer_same_shape)
 def _increment(ctx):
     step = ctx.attr("step", 1.0)
     unary(ctx, lambda x: x + jnp.asarray(step, x.dtype))

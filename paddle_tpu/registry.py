@@ -27,6 +27,28 @@ class SkipInferShape(Exception):
     """Raised by infer_shape rules that cannot infer statically."""
 
 
+def infer_same_shape(op, block):
+    """Shared infer_shape for elementwise/unary ops: Out mirrors X.
+
+    Fills in missing output metadata (shape/dtype/lod) from the single
+    X input; raises ``SkipInferShape`` when the pattern doesn't apply
+    (multi-arg slots, undeclared vars, unknown input shape).  Never
+    rejects — validation belongs to the analysis passes, which re-run
+    these rules over the built program (paddle_tpu/analysis)."""
+    xs = op.inputs.get("X", [])
+    outs = op.outputs.get("Out", [])
+    if len(xs) != 1 or len(outs) != 1 or not xs[0] or not outs[0]:
+        raise SkipInferShape
+    xv = block.find_var(xs[0])
+    ov = block.find_var(outs[0])
+    if xv is None or ov is None:
+        raise SkipInferShape
+    if ov.shape is None and xv.shape is not None:
+        ov.shape = tuple(xv.shape)
+    if ov.lod_level == 0 and xv.lod_level:
+        ov.lod_level = xv.lod_level
+
+
 @dataclasses.dataclass
 class OpInfo:
     type: str
@@ -63,8 +85,24 @@ class OpRegistry:
 
             info = synthesize_grad_info(type)
         if info is None and not none_ok:
-            raise KeyError(f"op {type!r} is not registered")
+            msg = f"op {type!r} is not registered"
+            close = cls.suggest(type, n=1)
+            if close:
+                msg += f"; did you mean {close[0]!r}?"
+            raise KeyError(msg)
         return info
+
+    @classmethod
+    def suggest(cls, type: str, n: int = 3) -> List[str]:
+        """Closest registered op names (for did-you-mean diagnostics)."""
+        import difflib
+
+        candidates = list(cls._ops)
+        # a mistyped grad op should suggest the registered forward's
+        # grad form, which resolves via synthesize_grad_info
+        if type.endswith("_grad"):
+            candidates += [op + "_grad" for op in cls._ops]
+        return difflib.get_close_matches(type, candidates, n=n, cutoff=0.6)
 
     @classmethod
     def has(cls, type: str) -> bool:

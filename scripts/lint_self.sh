@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Self-lint: run the program verifier over the shipped demo configs,
+# audit op-registry metadata coverage against the checked-in baseline,
+# and (when available) run ruff over the analysis package itself.
+# Kept green by tests/test_lint_tooling.py in tier-1.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+PADDLE="python scripts/paddle"
+
+echo "== paddle lint: demo/book configs"
+for conf in demos/mnist_v1/trainer_config.py \
+            demos/quick_start/trainer_config.py \
+            demos/sequence_tagging/trainer_config.py \
+            demos/traffic_prediction/trainer_config.py; do
+    echo "-- $conf"
+    $PADDLE lint "$conf"
+done
+
+echo "== paddle lint: registry metadata audit"
+$PADDLE lint --audit-registry
+
+echo "== ruff: paddle_tpu/analysis"
+if command -v ruff >/dev/null 2>&1; then
+    ruff check paddle_tpu/analysis/
+else
+    echo "ruff not installed; skipping style pass"
+fi
+
+echo "lint_self OK"

@@ -1,0 +1,326 @@
+"""Program verifier tests (paddle_tpu/analysis): one deliberately
+broken program per check, asserting the exact diagnostic code fires;
+plus the Executor pre-compile gate, the registry-coverage audit, and
+the did-you-mean registry errors."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import analysis, layers
+from paddle_tpu.analysis import ProgramVerificationError, Severity
+from paddle_tpu.flags import FLAGS
+from paddle_tpu.registry import OpInfo, OpRegistry, SkipInferShape
+
+
+def _codes(diags):
+    return {d.code for d in diags}
+
+
+def _verify(program=None, feeds=None, fetches=None, level="warning"):
+    return analysis.verify_program(
+        program or fluid.default_main_program(),
+        feed_names=feeds, fetch_names=fetches, level=level)
+
+
+# ---------------------------------------------------------------------------
+# one broken program per check
+# ---------------------------------------------------------------------------
+
+
+def test_read_before_write_fires_pve01():
+    block = fluid.default_main_program().global_block()
+    block.create_var(name="out", shape=[4], dtype="float32")
+    block.append_op(type="relu", inputs={"X": ["never_written"]},
+                    outputs={"Out": ["out"]})
+    diags = _verify(feeds=set(), fetches=["out"], level="error")
+    assert "PVE01" in _codes(diags), diags
+    (d,) = [d for d in diags if d.code == "PVE01"]
+    assert d.var == "never_written" and d.op_idx == 0 and d.block_idx == 0
+    assert d.severity == Severity.ERROR and d.op_type == "relu"
+
+
+def test_read_of_later_write_fires_pve01():
+    """Top-level blocks are ordered: reading a var that only a LATER op
+    writes is still read-before-write."""
+    block = fluid.default_main_program().global_block()
+    block.create_var(name="a", shape=[4], dtype="float32")
+    block.create_var(name="b", shape=[4], dtype="float32")
+    block.append_op(type="relu", inputs={"X": ["a"]}, outputs={"Out": ["b"]})
+    block.append_op(type="fill_constant", outputs={"Out": ["a"]},
+                    attrs={"shape": [4], "value": 1.0, "dtype": "float32"})
+    diags = _verify(feeds=set(), fetches=["b"], level="error")
+    assert any(d.code == "PVE01" and d.var == "a" for d in diags), diags
+
+
+def test_dtype_clash_fires_pve03():
+    x = layers.data(name="x", shape=[4], dtype="float32")
+    y = layers.data(name="y", shape=[4], dtype="int32")
+    out = layers.elementwise_add(x=x, y=y)
+    diags = _verify(feeds={"x", "y"}, fetches=[out.name], level="error")
+    (d,) = [d for d in diags if d.code == "PVE03"]
+    assert d.op_type == "elementwise_add" and "int32" in d.message
+
+
+def test_dangling_fetch_fires_pve02():
+    x = layers.data(name="x", shape=[4])
+    layers.fc(input=x, size=3)
+    diags = _verify(feeds={"x"}, fetches=["no_such_var"], level="error")
+    (d,) = [d for d in diags if d.code == "PVE02"]
+    assert d.var == "no_such_var" and "no_such_var" in d.message
+    assert "fetch list" in d.message
+
+
+def test_waw_overwrite_fires_pvw01():
+    block = fluid.default_main_program().global_block()
+    block.create_var(name="t", shape=[2], dtype="float32")
+    for value in (1.0, 2.0):
+        block.append_op(type="fill_constant", outputs={"Out": ["t"]},
+                        attrs={"shape": [2], "value": value,
+                               "dtype": "float32"})
+    diags = _verify(feeds=set(), fetches=["t"], level="warning")
+    (d,) = [d for d in diags if d.code == "PVW01"]
+    assert d.var == "t" and d.op_idx == 1
+
+
+def test_waw_spares_read_modify_write():
+    """increment reads what it writes — no WAW; and an intervening read
+    keeps a rewrite legitimate."""
+    block = fluid.default_main_program().global_block()
+    block.create_var(name="t", shape=[2], dtype="float32")
+    block.append_op(type="fill_constant", outputs={"Out": ["t"]},
+                    attrs={"shape": [2], "value": 0.0, "dtype": "float32"})
+    block.append_op(type="increment", inputs={"X": ["t"]},
+                    outputs={"Out": ["t"]}, attrs={"step": 1.0})
+    diags = _verify(feeds=set(), fetches=["t"], level="warning")
+    assert "PVW01" not in _codes(diags), diags
+
+
+def test_bad_sub_block_fires_pve04():
+    other = fluid.Program()  # block from a foreign program
+    block = fluid.default_main_program().global_block()
+    block.create_var(name="c", shape=[1], dtype="bool")
+    block.append_op(type="fill_constant", outputs={"Out": ["c"]},
+                    attrs={"shape": [1], "value": 0.0, "dtype": "bool"})
+    block.append_op(type="while", inputs={"Condition": ["c"], "X": []},
+                    outputs={"Out": []},
+                    attrs={"sub_block": other.global_block()})
+    diags = _verify(feeds=set(), fetches=["c"], level="error")
+    (d,) = [d for d in diags if d.code == "PVE04"]
+    assert d.op_type == "while" and "different Program" in d.message
+
+
+def test_unknown_op_fires_pve05_with_suggestion():
+    block = fluid.default_main_program().global_block()
+    block.create_var(name="a", shape=[2], dtype="float32")
+    block.create_var(name="b", shape=[2], dtype="float32")
+    op = fluid.Operator.__new__(fluid.Operator)
+    op.block, op.type = block, "sofmax"  # typo for softmax
+    op.inputs, op.outputs = {"X": ["a"]}, {"Out": ["b"]}
+    op.attrs = {}
+    block.ops.append(op)
+    diags = _verify(feeds={"a"}, fetches=["b"], level="error")
+    (d,) = [d for d in diags if d.code == "PVE05"]
+    assert "sofmax" in d.message and "softmax" in (d.hint or "")
+
+
+def test_grad_pairing_fires_pve06():
+    block = fluid.default_main_program().global_block()
+    block.create_var(name="phantom@GRAD", shape=[4], dtype="float32")
+    diags = _verify(feeds=set(), fetches=None, level="error")
+    (d,) = [d for d in diags if d.code == "PVE06"]
+    assert "phantom" in d.message
+
+
+def test_shape_infer_rejection_fires_pve07():
+    def strict_same_shape(op, block):
+        xv = block.find_var(op.inputs["X"][0])
+        ov = block.find_var(op.outputs["Out"][0])
+        if xv is None or ov is None or xv.shape is None or ov.shape is None:
+            raise SkipInferShape
+        if tuple(xv.shape) != tuple(ov.shape):
+            raise ValueError(f"shape {ov.shape} != input {xv.shape}")
+
+    OpRegistry.register(OpInfo(type="t_strict_unary", lower=lambda ctx: None,
+                               infer_shape=strict_same_shape,
+                               input_slots=("X",)))
+    try:
+        block = fluid.default_main_program().global_block()
+        block.create_var(name="a", shape=[4], dtype="float32")
+        out = block.create_var(name="b", shape=[4], dtype="float32")
+        block.append_op(type="t_strict_unary", inputs={"X": ["a"]},
+                        outputs={"Out": ["b"]})
+        out.shape = (5,)  # break the declared metadata after the fact
+        diags = _verify(feeds={"a"}, fetches=["b"], level="error")
+        (d,) = [d for d in diags if d.code == "PVE07"]
+        assert d.op_type == "t_strict_unary"
+    finally:
+        OpRegistry._ops.pop("t_strict_unary", None)
+
+
+def test_persistable_double_write_fires_pvw02():
+    block = fluid.default_main_program().global_block()
+    block.create_var(name="state", shape=[2], dtype="float32",
+                     persistable=True)
+    for value in (1.0, 2.0):
+        block.append_op(type="fill_constant", outputs={"Out": ["state"]},
+                        attrs={"shape": [2], "value": value,
+                               "dtype": "float32"})
+    diags = _verify(feeds=set(), fetches=["state"], level="warning")
+    (d,) = [d for d in diags if d.code == "PVW02"]
+    assert d.var == "state" and "last write wins" in d.message
+
+
+def test_unused_feed_fires_pvw03():
+    x = layers.data(name="x", shape=[4])
+    unused = layers.data(name="unused", shape=[4])
+    out = layers.fc(input=x, size=3)
+    diags = _verify(feeds={"x", "unused"}, fetches=[out.name],
+                    level="warning")
+    (d,) = [d for d in diags if d.code == "PVW03"]
+    assert d.var == "unused"
+
+
+def test_dead_code_reported_at_info():
+    x = layers.data(name="x", shape=[4])
+    live = layers.fc(input=x, size=3)
+    layers.relu(x)  # result reaches nothing
+    diags = _verify(feeds={"x"}, fetches=[live.name], level="all")
+    assert any(d.code == "PVI01" and d.op_type == "relu" for d in diags), \
+        diags
+
+
+def test_clean_training_program_verifies_clean():
+    """A full fc+loss+SGD training program: no diagnostics at any tier
+    (the same property the fuzz suite holds for sampled programs)."""
+    x = layers.data(name="x", shape=[8])
+    y = layers.data(name="y", shape=[8])
+    out = layers.fc(input=x, size=8, act="relu")
+    loss = layers.mean(layers.square_error_cost(input=out, label=y))
+    fluid.optimizer.SGD(learning_rate=1e-3).minimize(loss)
+    for program, feeds, fetches in (
+            (fluid.default_main_program(), {"x", "y"}, [loss.name]),
+            (fluid.default_startup_program(), set(), None)):
+        diags = _verify(program, feeds=feeds, fetches=fetches, level="all")
+        assert not diags, analysis.format_report(diags)
+
+
+def test_while_program_verifies_clean():
+    """Loop-carried reads inside a While sub-block are legal (unordered
+    region), and the sub-block descent sees enclosing defs."""
+    i = layers.fill_constant(shape=(1,), dtype="float32", value=0.0)
+    n = layers.fill_constant(shape=(1,), dtype="float32", value=4.0)
+    acc = layers.fill_constant(shape=(1,), dtype="float32", value=0.0)
+    cond = layers.less_than(i, n)
+    w = layers.While(cond)
+    with w.block():
+        new_acc = layers.elementwise_add(x=acc, y=i)
+        layers.assign(new_acc, output=acc)
+        layers.increment(i, value=1.0, in_place=True)
+        layers.assign(layers.less_than(i, n), output=cond)
+    diags = _verify(feeds=set(), fetches=[acc.name], level="error")
+    assert not diags, analysis.format_report(diags)
+
+
+# ---------------------------------------------------------------------------
+# Executor pre-compile gate
+# ---------------------------------------------------------------------------
+
+
+def test_executor_dangling_fetch_clear_error():
+    """Fetching a var no op writes names the variable and the fetch
+    list up front instead of a KeyError mid-trace (flag NOT required)."""
+    x = layers.data(name="x", shape=[4])
+    layers.fc(input=x, size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    with pytest.raises(RuntimeError) as ei:
+        exe.run(feed={"x": np.zeros((2, 4), np.float32)},
+                fetch_list=["ghost_var"])
+    assert "ghost_var" in str(ei.value)
+    assert "fetch list" in str(ei.value)
+
+
+def test_executor_check_program_flag_rejects_before_trace():
+    block = fluid.default_main_program().global_block()
+    block.create_var(name="out", shape=[4], dtype="float32")
+    block.append_op(type="relu", inputs={"X": ["never_written"]},
+                    outputs={"Out": ["out"]})
+    FLAGS.set("check_program", True)
+    try:
+        exe = fluid.Executor(fluid.CPUPlace())
+        with pytest.raises(ProgramVerificationError) as ei:
+            exe.run(feed={}, fetch_list=["out"])
+        assert "PVE01" in str(ei.value)
+        assert "never_written" in str(ei.value)
+    finally:
+        FLAGS.set("check_program", False)
+
+
+def test_executor_check_program_flag_passes_valid_program():
+    FLAGS.set("check_program", True)
+    try:
+        x = layers.data(name="x", shape=[4])
+        out = layers.fc(input=x, size=3, act="relu")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        (o,) = exe.run(feed={"x": np.ones((2, 4), np.float32)},
+                       fetch_list=[out])
+        assert o.shape == (2, 3)
+    finally:
+        FLAGS.set("check_program", False)
+
+
+# ---------------------------------------------------------------------------
+# registry: audit ratchet + did-you-mean
+# ---------------------------------------------------------------------------
+
+
+def test_registry_audit_clean_against_checked_in_baseline():
+    """HEAD must be regression-free against the checked-in baseline —
+    this is the acceptance gate that coverage only ratchets up."""
+    errs = [d for d in analysis.audit_registry()
+            if d.severity == Severity.ERROR]
+    assert not errs, analysis.format_report(errs)
+
+
+def test_registry_audit_catches_regression():
+    OpRegistry.register(OpInfo(type="t_bare_op", lower=lambda ctx: None))
+    try:
+        errs = [d for d in analysis.audit_registry()
+                if d.severity == Severity.ERROR]
+        assert any(d.code == "PVA01" and d.var == "t_bare_op"
+                   for d in errs), errs
+        assert any(d.code == "PVA02" and d.var == "t_bare_op"
+                   for d in errs), errs
+    finally:
+        OpRegistry._ops.pop("t_bare_op", None)
+
+
+def test_registry_audit_flags_stale_baseline_entries():
+    baseline = analysis.load_baseline()
+    baseline["missing_infer_shape"] = (baseline["missing_infer_shape"]
+                                       + ["t_never_registered"])
+    diags = analysis.audit_registry(baseline)
+    assert any(d.code == "PVA03" and d.var == "t_never_registered"
+               for d in diags), diags
+
+
+def test_registry_get_suggests_close_name():
+    with pytest.raises(KeyError) as ei:
+        OpRegistry.get("rellu")
+    assert "did you mean 'relu'" in str(ei.value)
+    with pytest.raises(KeyError) as ei:
+        OpRegistry.get("sofmax_grad")
+    assert "softmax_grad" in str(ei.value)
+
+
+def test_infer_same_shape_fills_missing_metadata():
+    """The shared infer_shape rule backfills an undeclared output shape
+    at append time (build-time InferShape, reference op_desc.cc)."""
+    block = fluid.default_main_program().global_block()
+    block.create_var(name="src", shape=[3, 7], dtype="float32")
+    block.create_var(name="dst", dtype="float32")  # no shape
+    block.append_op(type="relu", inputs={"X": ["src"]},
+                    outputs={"Out": ["dst"]})
+    assert block.var("dst").shape == (3, 7)

@@ -16,6 +16,22 @@ def _fresh():
     yield
 
 
+def _assert_verifies_clean(names, seed, feeds, fetches, program=None):
+    """Static-verifier oracle (paddle_tpu/analysis): every fuzzed
+    program must pass the error tier before it is allowed to run —
+    IR-construction bugs (dangling reads, dtype clashes, broken grad
+    pairing) must not hide behind a runtime that happens to cope."""
+    from paddle_tpu import analysis
+
+    program = program or fluid.default_main_program()
+    diags = analysis.verify_program(program, feed_names=set(feeds),
+                                    fetch_names=list(fetches),
+                                    level="error")
+    assert not diags, (
+        f"chain {names} (seed {seed}) built an invalid program:\n"
+        + analysis.format_report(diags))
+
+
 B, D = 4, 8
 
 # each entry: (name, callable(x) -> variable, keeps_width)
@@ -68,6 +84,10 @@ def test_random_program_trains_and_prunes(seed):
         fluid.layers.square_error_cost(input=out, label=label))
     fluid.optimizer.SGD(learning_rate=1e-3).minimize(loss)
 
+    _assert_verifies_clean(names, seed, ["x", "y"], [loss.name])
+    _assert_verifies_clean(names, seed, [], [],
+                           program=fluid.default_startup_program())
+
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(fluid.default_startup_program())
     feed = {"x": rng.randn(B, D).astype("float32") * 0.5,
@@ -82,6 +102,8 @@ def test_random_program_trains_and_prunes(seed):
         # the inference prune of the same program must run and be
         # training-free
         infer = fluid.io.get_inference_program([out])
+        _assert_verifies_clean(names, seed, ["x"], [out.name],
+                               program=infer)
         (o,) = exe.run(infer, feed={"x": feed["x"]}, fetch_list=[out])
         assert np.isfinite(np.asarray(o)).all()
         assert not any(op.type == "sgd"
@@ -111,6 +133,7 @@ def test_random_program_grads_match_numeric(seed):
     if not pgs:  # no live fc in the sampled chain — nothing to check
         return
     p, gvar = pgs[0]
+    _assert_verifies_clean(names, seed, ["x", "y"], [gvar.name])
 
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(fluid.default_startup_program())
@@ -151,6 +174,8 @@ def test_random_program_trains_under_amp(seed):
     loss = fluid.layers.mean(
         fluid.layers.square_error_cost(input=out, label=label))
     fluid.optimizer.Momentum(learning_rate=1e-3, momentum=0.9).minimize(loss)
+
+    _assert_verifies_clean(names, seed, ["x", "y"], [loss.name])
 
     exe = fluid.Executor(fluid.CPUPlace())
     with amp.amp_guard(True):
