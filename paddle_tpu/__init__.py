@@ -57,5 +57,6 @@ from paddle_tpu import flags
 from paddle_tpu import stat
 from paddle_tpu import errors
 from paddle_tpu import analysis
+from paddle_tpu import observability
 
 __version__ = "0.1.0"

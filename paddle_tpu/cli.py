@@ -18,6 +18,13 @@ Subcommands:
       (static program verification — paddle_tpu/analysis; exits nonzero
        on error diagnostics.  --audit-registry checks op-metadata
        coverage against the checked-in baseline)
+  paddle stats [--json] [--run=script.py] [--file=telemetry.json]
+               [--url=http://host:port] [--trace=out.json]
+      (snapshot the telemetry registry — paddle_tpu/observability — as
+       a human table or JSON; --run execs a fluid script first so its
+       Executor.run counters show, --url scrapes a live `paddle serve`
+       /stats endpoint, --file renders a bench telemetry artifact,
+       --trace also exports the host event ring as Chrome-trace JSON)
   paddle pserver [--port=P] [--checkpoint=PATH] [--checkpoint_sec=S]
   paddle master [--port=P] [--lease_sec=S] [--failure_max=N]
   paddle coord  [--port=P]
@@ -284,12 +291,79 @@ def cmd_lint(argv):
     return 1 if bad else 0
 
 
+def cmd_stats(argv):
+    """paddle stats [--json] [--run=script.py] [--file=artifact.json]
+    [--url=http://host:port] [--trace=out.json]
+
+    Dump the observability registry (paddle_tpu/observability): every
+    counter/gauge/histogram the executor, serving, and trainer paths
+    recorded, as a human table or JSON.  Sources, in precedence order:
+    a live server's /stats endpoint (--url), a bench telemetry artifact
+    (--file), or this process's registry (optionally after exec'ing a
+    fluid script via --run so its Executor.run calls are measured).
+    """
+    import json as json_mod
+
+    from paddle_tpu import observability as obs
+
+    args, rest = _kv_args(argv)
+    as_json = "--json" in rest
+    if args.get("trace") and args.get("url"):
+        print("--trace: host events live in the server process and are "
+              "not exported over /stats; run paddle stats --trace "
+              "in-process instead", file=sys.stderr)
+        return 2
+    if args.get("url"):
+        import urllib.request
+
+        url = args["url"].rstrip("/") + "/stats"
+        with urllib.request.urlopen(url, timeout=30) as r:
+            snap = json_mod.loads(r.read())
+    elif args.get("file"):
+        with open(args["file"]) as f:
+            data = json_mod.load(f)
+        # a bench telemetry artifact nests the registry under "metrics";
+        # a raw snapshot dump IS the registry
+        snap = data.get("metrics", data) or {}
+    else:
+        if args.get("run"):
+            _cwd_importable()
+            path = args["run"]
+            glb = {"__file__": path, "__name__": "__paddle_stats__"}
+            with open(path) as f:
+                exec(compile(f.read(), path, "exec"), glb)
+        snap = obs.snapshot()
+    if as_json:
+        print(json_mod.dumps(snap, indent=1, sort_keys=True))
+    else:
+        table = obs.format_snapshot(snap)
+        print(table if table else
+              "telemetry registry is empty (no metrics recorded)")
+    if args.get("trace"):
+        if args.get("file"):
+            # a bench artifact embeds its run's Chrome trace — export
+            # that, not this CLI process's (empty) event ring
+            trace = data.get("events")
+            if not trace:
+                print(f"--trace: {args['file']} carries no embedded "
+                      "host events", file=sys.stderr)
+                return 2
+            with open(args["trace"], "w") as f:
+                json_mod.dump(trace, f)
+        else:
+            obs.export_chrome_trace(args["trace"])
+        print(f"host events written to {args['trace']} "
+              "(chrome://tracing)", file=sys.stderr)
+    return 0
+
+
 COMMANDS = {
     "train": cmd_train,
     "version": cmd_version,
     "merge_model": cmd_merge_model,
     "serve": cmd_serve,
     "lint": cmd_lint,
+    "stats": cmd_stats,
     "pserver": cmd_pserver,
     "master": cmd_master,
     "coord": cmd_coord,

@@ -17,6 +17,7 @@ parameter updates alias in HBM with no host round-trip.
 from __future__ import annotations
 
 import contextlib
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -27,8 +28,51 @@ import jax.numpy as jnp
 from paddle_tpu import framework
 from paddle_tpu.framework import Program, Variable, TPUPlace, Place
 from paddle_tpu.lod import LoDArray
+from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.observability.events import GLOBAL_EVENTS as _EVENTS
 from paddle_tpu.registry import LowerContext, OpRegistry, RngState
 from paddle_tpu.sparse import SparseGrad
+
+
+# ---------------------------------------------------------------------------
+# Telemetry (paddle_tpu/observability) — every run() updates these; all
+# keyed by program fingerprint so `paddle stats` / GET /metrics can
+# attribute cost per compiled program.  Hot-path cost is a handful of
+# microseconds (observability.measure_step_overhead), negligible next
+# to a step dispatch.
+# ---------------------------------------------------------------------------
+
+_M_CACHE_MISS = _metrics.counter(
+    "executor_compile_cache_miss_total",
+    "Executor.run compile-cache misses (program verified, traced, compiled)")
+_M_CACHE_HIT = _metrics.counter(
+    "executor_compile_cache_hit_total",
+    "Executor.run compile-cache hits (cached XLA executable reused)")
+_M_COMPILE_SEC = _metrics.histogram(
+    "executor_compile_seconds",
+    "wall time per compile-cache miss: verify + build + jax trace/jit + "
+    "first step", buckets=_metrics.COMPILE_TIME_BUCKETS)
+_M_FEED_SEC = _metrics.histogram(
+    "executor_feed_convert_seconds",
+    "host-side feed-dict conversion time per run")
+_M_STEP_SEC = _metrics.histogram(
+    "executor_step_seconds",
+    "step dispatch wall time (cached='miss' rows include trace+compile)")
+_M_FETCH_SEC = _metrics.histogram(
+    "executor_fetch_seconds",
+    "fetch materialization (device->host sync) time per run")
+_M_FETCH_BYTES = _metrics.counter(
+    "executor_fetch_device_to_host_bytes_total",
+    "bytes copied device->host materializing return_numpy fetches")
+
+
+def _fetch_nbytes(v) -> int:
+    """Host bytes a converted fetch value occupies."""
+    if isinstance(v, LoDArray):
+        return v.data.nbytes + sum(o.nbytes for o in v.lod)
+    if isinstance(v, SparseGrad):
+        return v.rows.nbytes + v.values.nbytes
+    return getattr(v, "nbytes", 0)
 
 
 # ---------------------------------------------------------------------------
@@ -225,18 +269,24 @@ class Executor:
         fetch_list = list(fetch_list or [])
 
         block = program.global_block()
+        fp = self._program_key(program)
+        prog_label = fp[:12]
+
+        t_feed = time.perf_counter()
         feed_vals = {
             name: _convert_feed(v, block.find_var(name)) for name, v in feed.items()
         }
+        _M_FEED_SEC.observe(time.perf_counter() - t_feed, program=prog_label)
         fetch_names = tuple(
             v.name if isinstance(v, Variable) else str(v) for v in fetch_list
         )
 
         from paddle_tpu import amp
         from paddle_tpu import pallas as pk
+        from paddle_tpu.flags import FLAGS
 
         key = (
-            self._program_key(program),
+            fp,
             _feed_signature(feed_vals),
             fetch_names,
             self.place,
@@ -244,8 +294,11 @@ class Executor:
             amp.is_enabled(),
             pk.mode(),
             pk.interpret_mode(),
+            bool(FLAGS.get("trace_ops")),
         )
         compiled = self._cache.get(key)
+        cache_hit = compiled is not None
+        t_compile = time.perf_counter()
         if compiled is None:
             # Pre-compile static checks (paddle_tpu/analysis).  The fetch
             # check always runs — fetching a never-written variable must
@@ -253,9 +306,13 @@ class Executor:
             # With the check_program flag on, the full error tier runs
             # (def-before-use, dtype clash, bad sub-blocks, ...) before
             # any JAX tracing.  Cache hits skip both: already vetted.
-            self._verify(program, feed_vals, fetch_names)
-            compiled = self._compile(program, feed_vals, fetch_names, scope)
+            _M_CACHE_MISS.inc(program=prog_label)
+            with _EVENTS.span("executor.compile", program=prog_label):
+                self._verify(program, feed_vals, fetch_names)
+                compiled = self._compile(program, feed_vals, fetch_names, scope)
             self._cache[key] = compiled
+        else:
+            _M_CACHE_HIT.inc(program=prog_label)
 
         state = {}
         missing = []
@@ -274,12 +331,27 @@ class Executor:
         args = [state, feed_vals]
         if compiled.uses_rng:
             args.append(np.int64(self._seed_for_step(program)))
+        tag = "hit" if cache_hit else "miss"
+        ev_t0 = _EVENTS.now()
+        t_step = time.perf_counter()
         fetches, new_state = compiled.fn(*args)
+        dt_step = time.perf_counter() - t_step
+        _M_STEP_SEC.observe(dt_step, program=prog_label, cached=tag)
+        _EVENTS.complete("executor.step", ev_t0, dt_step,
+                         program=prog_label, cached=tag)
+        if not cache_hit:
+            # trace + jit + the first (compiling) dispatch: jax defers
+            # tracing/XLA work to the first call, so the honest
+            # per-compile wall time spans through that call
+            _M_COMPILE_SEC.observe(time.perf_counter() - t_compile,
+                                   program=prog_label)
 
         for n, v in new_state.items():
             scope.set(n, v)
 
+        t_fetch = time.perf_counter()
         out = []
+        nbytes = 0
         for v in fetches:
             if return_numpy:
                 if isinstance(v, LoDArray):
@@ -289,7 +361,13 @@ class Executor:
                                    v.height)
                 else:
                     v = np.asarray(v)
+                nbytes += _fetch_nbytes(v)
             out.append(v)
+        if return_numpy and out:
+            _M_FETCH_SEC.observe(time.perf_counter() - t_fetch,
+                                 program=prog_label)
+            if nbytes:
+                _M_FETCH_BYTES.inc(nbytes, program=prog_label)
         return out
 
     # -- internals ----------------------------------------------------------
@@ -417,6 +495,28 @@ class Executor:
 
         strategy = self.strategy
 
+        # Opt-in per-op tracing (flags trace_ops=1): jax.named_scope
+        # threads "<op_type>_<idx>" into the HLO op metadata so xprof/
+        # tensorboard traces show op names instead of anonymous fused
+        # regions, and TraceAnnotation marks the same span on the host
+        # timeline when the block runs un-jitted (build_callable).  The
+        # flag is part of the compile-cache key — flipping it retraces.
+        from paddle_tpu.flags import FLAGS
+
+        trace_ops = bool(FLAGS.get("trace_ops"))
+        op_index = {id(op): i for i, op in enumerate(ops)}
+
+        def _lower_op(op, vals, op_rng):
+            info = OpRegistry.get(op.type)
+            ctx = LowerContext(op, vals, rng=op_rng, executor_ctx=program)
+            if trace_ops:
+                i = op_index[id(op)]
+                with jax.named_scope(f"{op.type}_{i}"), \
+                        jax.profiler.TraceAnnotation(f"{op.type}:{i}"):
+                    info.lower(ctx)
+            else:
+                info.lower(ctx)
+
         # Rematerialization segments (fluid.recompute_scope): group
         # consecutive forward ops sharing a __recompute_seg__ id.  A
         # segment's intermediates stay LOCAL — only values consumed by
@@ -462,24 +562,20 @@ class Executor:
                 for seg, seg_ops in op_groups:
                     if seg is None:
                         for op in seg_ops:
-                            info = OpRegistry.get(op.type)
-                            info.lower(LowerContext(op, values, rng=rng,
-                                                    executor_ctx=program))
+                            _lower_op(op, values, rng)
                         continue
                     # the segment's randomness comes from its key op's
                     # output (shared with the backward recompute)
                     seg_key = values.get(f"__segkey_{seg}__")
                     local = dict(values)
                     for op in seg_ops:
-                        info = OpRegistry.get(op.type)
                         # per-op key folded from the segment key and the
                         # op's stable index (no key value — e.g. startup
                         # init ops created inside the scope — falls back
                         # to the plain outer rng)
                         op_rng = (_segment_op_rng(seg_key, op)
                                   if seg_key is not None else rng)
-                        info.lower(LowerContext(op, local, rng=op_rng,
-                                                executor_ctx=program))
+                        _lower_op(op, local, op_rng)
                     for n in seg_exports[id(seg_ops[0])]:
                         values[n] = local[n]
             fetches = [values[n] for n in fetch_names]

@@ -60,6 +60,16 @@ FLAGS.define("check_program",
              os.environ.get("PADDLE_CHECK_PROGRAM", "").lower()
              in ("1", "true", "yes"),
              "verify programs before compiling (error-tier analysis passes)")
+# TPU-era addition: per-op trace spans (paddle_tpu/observability).  With
+# trace_ops=1 the executor wraps each op's lowering in jax.named_scope
+# + jax.profiler.TraceAnnotation so xprof traces name ops instead of
+# anonymous XLA regions.  Flipping it retraces (part of the compile
+# cache key); seeded from PADDLE_TRACE_OPS so profiling runs need no
+# code change.
+FLAGS.define("trace_ops",
+             os.environ.get("PADDLE_TRACE_OPS", "").lower()
+             in ("1", "true", "yes"),
+             "name each op in device traces (named_scope/TraceAnnotation)")
 
 
 def init_gflags(argv):

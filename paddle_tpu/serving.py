@@ -6,6 +6,12 @@ signature, so repeated requests hit the compile cache).
 
 Endpoints:
   GET  /health           → {"status": "ok", "feeds": [...], "fetches": [...]}
+  GET  /metrics          → Prometheus text exposition (0.0.4): request
+                           latency histogram (p50/p95/p99 derivable),
+                           in-flight gauge, per-status-code counters,
+                           plus the executor's compile/step metrics
+  GET  /stats            → the observability registry snapshot as JSON
+                           (what `paddle stats --url=...` renders)
   POST /predict          → body {"<feed>": nested-list, ...}
                            → {"outputs": [nested-list per fetch]}
 
@@ -16,9 +22,21 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
+
+from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.observability.events import GLOBAL_EVENTS as _EVENTS
+
+_M_REQ_SEC = _metrics.histogram(
+    "serving_request_seconds",
+    "wall time per inference request, including executor dispatch")
+_M_INFLIGHT = _metrics.gauge(
+    "serving_inflight_requests", "requests currently being handled")
+_M_RESPONSES = _metrics.counter(
+    "serving_responses_total", "HTTP responses by status code")
 
 
 def _jsonable(o):
@@ -52,10 +70,12 @@ class InferenceServer:
             def log_message(self, *a):  # quiet
                 pass
 
-            def _reply(self, code, obj):
-                body = json.dumps(obj).encode()
+            def _reply(self, code, obj, ctype="application/json",
+                       raw=None):
+                body = raw if raw is not None else json.dumps(obj).encode()
+                _M_RESPONSES.inc(code=str(code))
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -67,6 +87,13 @@ class InferenceServer:
                         "feeds": server.feed_names,
                         "fetches": [getattr(f, "name", str(f))
                                     for f in server._fetches]})
+                elif self.path == "/metrics":
+                    self._reply(
+                        200, None,
+                        ctype="text/plain; version=0.0.4; charset=utf-8",
+                        raw=_metrics.render_prometheus().encode())
+                elif self.path == "/stats":
+                    self._reply(200, _metrics.snapshot())
                 else:
                     self._reply(404, {"error": "unknown path"})
 
@@ -74,6 +101,9 @@ class InferenceServer:
                 if self.path != "/predict":
                     self._reply(404, {"error": "unknown path"})
                     return
+                _M_INFLIGHT.inc()
+                ev_t0 = _EVENTS.now()
+                t0 = time.perf_counter()
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(n) or b"{}")
@@ -84,6 +114,12 @@ class InferenceServer:
                     self._reply(400, {"error": str(e)})
                 except Exception as e:  # surface, don't kill the server
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                finally:
+                    dt = time.perf_counter() - t0
+                    _M_INFLIGHT.dec()
+                    _M_REQ_SEC.observe(dt, endpoint="/predict")
+                    _EVENTS.complete("serving.predict", ev_t0, dt,
+                                     cat="serving")
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self._thread = threading.Thread(target=self._httpd.serve_forever,

@@ -5,11 +5,17 @@ printed per N batches / per pass; enabled with WITH_TIMER).
 Host-side timers measure the interpreter/driver path (data feed, feed
 conversion, dispatch); device time belongs to jax.profiler
 (paddle_tpu.profiler) — same split as the reference's Stat vs nvprof.
+
+Kept as the reference-compatible surface; the general-purpose metrics
+layer (labels, histograms, Prometheus exposition) lives in
+``paddle_tpu.observability``, whose table formatter this module's
+``print_status`` delegates to.
 """
 
 from __future__ import annotations
 
 import contextlib
+import functools
 import threading
 import time
 from typing import Dict
@@ -49,17 +55,23 @@ class StatSet:
             return dict(self._items)
 
     def print_status(self, out=None):
-        """The per-pass dump (Stat.h printAllStatus format, simplified)."""
+        """The per-pass dump (Stat.h printAllStatus, via the shared
+        observability table formatter)."""
         import sys
 
+        from paddle_tpu.observability.metrics import format_table
+
         out = out or sys.stderr
-        rows = sorted(self.items().items(), key=lambda kv: -kv[1].total)
+        rows = [
+            (key, f"{it.total * 1e3:.2f}",
+             f"{it.total / max(it.count, 1) * 1e3:.3f}",
+             f"{it.max * 1e3:.3f}", str(it.count))
+            for key, it in sorted(self.items().items(),
+                                  key=lambda kv: -kv[1].total)
+        ]
         print(f"======= StatSet: [{self.name}] =======", file=out)
-        for key, it in rows:
-            avg = it.total / max(it.count, 1)
-            print(f"  {key:<32} total={it.total * 1e3:10.2f}ms "
-                  f"avg={avg * 1e3:8.3f}ms max={it.max * 1e3:8.3f}ms "
-                  f"count={it.count}", file=out)
+        print(format_table(rows, headers=("timer", "total_ms", "avg_ms",
+                                          "max_ms", "count")), file=out)
 
 
 GLOBAL_STATS = StatSet()
@@ -79,11 +91,11 @@ def timed(name: str, stats: StatSet = None):
     """Decorator form."""
 
     def deco(fn):
+        @functools.wraps(fn)
         def wrapper(*a, **k):
             with timer(name, stats):
                 return fn(*a, **k)
 
-        wrapper.__name__ = fn.__name__
         return wrapper
 
     return deco

@@ -18,6 +18,36 @@ import numpy as np
 from paddle_tpu.trainer.config_parser import TrainerConfig, parse_config
 
 
+def _resolve_log_period(log_period):
+    """An explicit argument wins; otherwise the gflags-tier log_period
+    (reference: utils/Flags.cpp FLAGS_log_period, default 100)."""
+    if log_period is not None:
+        return max(int(log_period), 1)
+    from paddle_tpu.flags import FLAGS
+
+    return max(int(FLAGS.get("log_period", 100) or 100), 1)
+
+
+def _dump_layer_stat(pass_id, batch_id, out=None):
+    """--show_layer_stat: dump the runtime telemetry registry (compile/
+    step/feed metrics per program) plus any host StatSet timers every
+    log_period batches (reference: Stat.h printAllStatus under
+    WITH_TIMER + FLAGS_show_layer_stat)."""
+    import sys
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu import stat
+
+    out = out or sys.stderr
+    print(f"--- runtime stats (pass {pass_id}, batch {batch_id}) ---",
+          file=out)
+    table = obs.format_snapshot(obs.snapshot())
+    if table:
+        print(table, file=out)
+    if stat.GLOBAL_STATS.items():
+        stat.GLOBAL_STATS.print_status(out=out)
+
+
 class Trainer:
     """Drives a parsed v1 config: builds the topology on the v2 training
     stack, iterates the PyDataProvider2 generator, saves per-pass
@@ -95,9 +125,11 @@ class Trainer:
     # -- training -----------------------------------------------------------
 
     def train(self, num_passes: int = 1, save_dir: Optional[str] = None,
-              log_period: int = 100, event_handler=None):
+              log_period: Optional[int] = None, event_handler=None):
+        from paddle_tpu.flags import FLAGS
         from paddle_tpu.v2 import event as v2_event
 
+        log_period = _resolve_log_period(log_period)
         costs = []
 
         def handler(e):
@@ -110,6 +142,8 @@ class Trainer:
                           f"Cost {e.cost:.6f}"
                           + (f", Eval:{evals}" if evals else ""),
                           flush=True)
+                    if FLAGS.get("show_layer_stat"):
+                        _dump_layer_stat(e.pass_id, e.batch_id)
             if isinstance(e, v2_event.EndPass) and save_dir:
                 pass_dir = os.path.join(save_dir, f"pass-{e.pass_id:05d}")
                 os.makedirs(pass_dir, exist_ok=True)
@@ -271,7 +305,9 @@ def main(argv=None):
                    help="pass dir / save_dir / params.tar to load before "
                         "--job=test (reference ParamUtil::loadParameters)")
     p.add_argument("--config_args", default="")
-    p.add_argument("--log_period", type=int, default=100)
+    p.add_argument("--log_period", type=int, default=None,
+                   help="batches between log lines (default: the "
+                        "log_period flag, 100)")
     p.add_argument("--use_gpu", default=None, help="ignored (TPU build)")
     p.add_argument("--trainer_count", type=int, default=1,
                    help="data-parallel shards (devices on the mesh)")

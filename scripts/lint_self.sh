@@ -21,9 +21,14 @@ done
 echo "== paddle lint: registry metadata audit"
 $PADDLE lint --audit-registry
 
-echo "== ruff: paddle_tpu/analysis"
+echo "== paddle stats: telemetry registry smoke"
+# the observability surface must at least import + render cleanly
+$PADDLE stats --json > /dev/null
+$PADDLE stats > /dev/null
+
+echo "== ruff: paddle_tpu/analysis + paddle_tpu/observability"
 if command -v ruff >/dev/null 2>&1; then
-    ruff check paddle_tpu/analysis/
+    ruff check paddle_tpu/analysis/ paddle_tpu/observability/
 else
     echo "ruff not installed; skipping style pass"
 fi

@@ -39,13 +39,17 @@ import pytest  # noqa: E402
 
 @pytest.fixture(autouse=True)
 def fresh_programs():
-    """Each test gets fresh default programs and a fresh global scope."""
+    """Each test gets fresh default programs, a fresh global scope, and
+    a zeroed telemetry registry (counters would otherwise accumulate
+    across tests in one process)."""
     from paddle_tpu import framework
     from paddle_tpu import executor as executor_mod
+    from paddle_tpu import observability
 
     framework.reset_default_programs()
     executor_mod._global_scope = executor_mod.Scope()
     executor_mod._scope_stack = [executor_mod._global_scope]
+    observability.reset()
     yield
 
 
