@@ -1,0 +1,209 @@
+#!/usr/bin/env python3
+"""Fault-injection harness for the elastic training supervisor.
+
+Runs the DemoRegression workload (paddle_tpu/distributed/elastic.py)
+against a real coord store + master, SIGKILLs a worker mid-epoch, and
+measures the recovery:
+
+  replace  (default)  one worker at a time, the pod-rescheduling shape:
+                      kill worker A after its first few checkpoint
+                      commits, wait for the lease to lapse, launch a
+                      replacement, and check the final loss is
+                      bit-identical to an unkilled in-process oracle.
+  survivor            two concurrent workers; kill one and verify the
+                      survivor finishes the pass (the master's TTL
+                      requeues the dead worker's in-flight task).
+
+Reports kill-to-resume latency, redone-task count, and the recovery
+counters (`elastic_*`, `rpc_*`) rendered the same way `paddle stats
+--file` does.  Writes a JSON artifact with --out.
+
+Usage:
+  python benchmark/chaos_bench.py [--mode=replace|survivor]
+      [--tasks=8] [--passes=4] [--task-sleep=0.15] [--kill-after-steps=2]
+      [--out=chaos.json]
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+import numpy as np  # noqa: E402
+
+from paddle_tpu.distributed import CoordClient, CoordServer, MasterServer  # noqa: E402
+from paddle_tpu.distributed.elastic import DemoRegression  # noqa: E402
+from paddle_tpu import io as io_mod  # noqa: E402
+from paddle_tpu.observability import format_snapshot  # noqa: E402
+
+
+def _spawn(coord, master, ckpt, wid, args, stats_out=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO_ROOT + os.pathsep +
+               os.environ.get("PYTHONPATH", ""))
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.elastic",
+           f"--coord={coord}", f"--master={master}", "--job=chaos",
+           f"--checkpoint-dir={ckpt}", f"--tasks={args.tasks}",
+           f"--passes={args.passes}", f"--task-sleep={args.task_sleep}",
+           "--lease-ttl=2", "--checkpoint-period=1", f"--worker-id={wid}",
+           f"--seed={args.seed}", f"--dim={args.dim}"]
+    if stats_out:
+        cmd.append(f"--stats-out={stats_out}")
+    return subprocess.Popen(cmd, env=env, cwd=REPO_ROOT,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True)
+
+
+def _wait_step(probe, key, min_step, timeout=120):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        got = probe.get(key)
+        if got is not None:
+            step = json.loads(got[1].decode())["step"]
+            if step >= min_step:
+                return step
+        time.sleep(0.05)
+    raise RuntimeError(f"no checkpoint reached step {min_step}")
+
+
+def _wait_lease_gone(probe, key, timeout=30):
+    t0 = time.time()
+    while probe.get(key) is not None:
+        if time.time() - t0 > timeout:
+            raise RuntimeError("worker lease never expired")
+        time.sleep(0.05)
+    return time.time() - t0
+
+
+def run_replace(args):
+    demo = DemoRegression(dim=args.dim, seed=args.seed)
+    oracle = demo.oracle(args.tasks, args.passes)
+    result = {"mode": "replace", "tasks": args.tasks, "passes": args.passes}
+    with CoordServer() as cs, MasterServer(lease_sec=2) as ms, \
+            tempfile.TemporaryDirectory() as tmp:
+        ck = os.path.join(tmp, "ck")
+        stats_json = os.path.join(tmp, "stats.json")
+        probe = CoordClient(cs.address)
+        a = _spawn(cs.address, ms.address, ck, "w-a", args)
+        killed_at = _wait_step(probe, "/elastic/chaos/manifest",
+                               args.kill_after_steps)
+        a.send_signal(signal.SIGKILL)
+        a.wait(timeout=30)
+        t_kill = time.time()
+        result["killed_at_step"] = killed_at
+        result["lease_lapse_seconds"] = _wait_lease_gone(
+            probe, "/elastic/chaos/workers/w-a")
+
+        b = _spawn(cs.address, ms.address, ck, "w-b", args,
+                   stats_out=stats_json)
+        out, err = b.communicate(timeout=600)
+        if b.returncode != 0:
+            raise RuntimeError(f"replacement worker failed:\n{out}\n{err}")
+        result["kill_to_finish_seconds"] = round(time.time() - t_kill, 3)
+        man = json.loads(probe.get("/elastic/chaos/manifest")[1].decode())
+        probe.close()
+        final = io_mod.load_state_tree(os.path.join(ck, "params"),
+                                       man["step"])
+        snap = json.load(open(stats_json))
+
+    loss_chaos = demo.loss(final)
+    loss_oracle = demo.loss(oracle)
+    result.update(
+        final_step=man["step"],
+        loss_chaos=loss_chaos, loss_oracle=loss_oracle,
+        loss_identical=bool(np.allclose(final["w"], oracle["w"],
+                                        rtol=0, atol=0)),
+        replacement_tasks=_snap_value(snap, "elastic_tasks_finished_total"),
+        recovered_tasks=_snap_value(snap, "elastic_recovered_tasks_total"),
+        counters={k: v for k, v in snap.items()
+                  if k.startswith(("elastic_", "rpc_"))},
+    )
+    print(f"killed w-a at step {killed_at}/{args.tasks * args.passes}; "
+          f"lease lapsed in {result['lease_lapse_seconds']:.2f}s; "
+          f"replacement finished in {result['kill_to_finish_seconds']:.2f}s")
+    print(f"loss chaos={loss_chaos:.9g} oracle={loss_oracle:.9g} "
+          f"identical={result['loss_identical']}")
+    print()
+    print(format_snapshot(result["counters"]))
+    assert result["loss_identical"], "recovery diverged from the oracle"
+    return result
+
+
+def run_survivor(args):
+    result = {"mode": "survivor", "tasks": args.tasks, "passes": 1}
+    with CoordServer() as cs, MasterServer(lease_sec=2) as ms, \
+            tempfile.TemporaryDirectory() as tmp:
+        ck = os.path.join(tmp, "ck")
+        stats_json = os.path.join(tmp, "stats.json")
+        probe = CoordClient(cs.address)
+        sargs = argparse.Namespace(**vars(args))
+        sargs.passes = 1
+        a = _spawn(cs.address, ms.address, os.path.join(ck, "a"), "w-a",
+                   sargs)
+        b = _spawn(cs.address, ms.address, os.path.join(ck, "b"), "w-b",
+                   sargs, stats_out=stats_json)
+        # kill A only once it is registered and has had time to lease a
+        # task, so the requeue path is actually exercised
+        deadline = time.time() + 60
+        while probe.get("/elastic/chaos/workers/w-a") is None:
+            if time.time() > deadline or a.poll() is not None:
+                break
+            time.sleep(0.05)
+        time.sleep(max(args.task_sleep * 3, 0.5))
+        a.send_signal(signal.SIGKILL)
+        a.wait(timeout=30)
+        t_kill = time.time()
+        out, err = b.communicate(timeout=600)
+        if b.returncode != 0:
+            raise RuntimeError(f"survivor failed:\n{out}\n{err}")
+        result["kill_to_finish_seconds"] = round(time.time() - t_kill, 3)
+        snap = json.load(open(stats_json))
+        probe.close()
+    survivor_tasks = _snap_value(snap, "elastic_tasks_finished_total")
+    result["survivor_tasks"] = survivor_tasks
+    result["counters"] = {k: v for k, v in snap.items()
+                          if k.startswith(("elastic_", "rpc_"))}
+    print(f"survivor finished the pass {result['kill_to_finish_seconds']:.2f}s "
+          f"after the kill, completing {survivor_tasks:g} of "
+          f"{args.tasks} tasks itself")
+    print()
+    print(format_snapshot(result["counters"]))
+    assert survivor_tasks >= 1
+    return result
+
+
+def _snap_value(snap, name):
+    fam = snap.get(name, {})
+    return sum(v["value"] for v in fam.get("values", []))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--mode", choices=("replace", "survivor"),
+                    default="replace")
+    ap.add_argument("--tasks", type=int, default=8)
+    ap.add_argument("--passes", type=int, default=4)
+    ap.add_argument("--dim", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--task-sleep", type=float, default=0.15)
+    ap.add_argument("--kill-after-steps", type=int, default=2)
+    ap.add_argument("--out", default=None, help="write a JSON artifact")
+    args = ap.parse_args()
+
+    result = (run_replace if args.mode == "replace" else run_survivor)(args)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+        print(f"\nartifact written to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,9 +11,16 @@ Subcommands:
        binary for the C API; here: re-parse the v1 config, load the
        pass params, export a save_inference_model directory that
        capi/paddle_tpu_capi.h consumes)
-  paddle serve --model_dir=DIR [--port=N]
+  paddle serve --model_dir=DIR [--port=N] [--request_timeout=SECONDS]
+               [--max_inflight=N]
       (HTTP JSON inference over a save_inference_model export —
-       paddle_tpu/serving.py)
+       paddle_tpu/serving.py; --request_timeout returns 504 on expiry,
+       --max_inflight sheds load with 503 instead of piling up threads)
+  paddle elastic --coord=HOST:PORT --checkpoint-dir=DIR [--job=NAME]
+                 [--tasks=N] [--passes=P] [--worker-id=ID] ...
+      (preemption-safe demo training worker —
+       paddle_tpu/distributed/elastic.py; kill it mid-epoch and a
+       relaunched worker resumes from the last committed checkpoint)
   paddle lint <program.json|config.py> [--level=...] [--strict] [--json]
       (static program verification — paddle_tpu/analysis; exits nonzero
        on error diagnostics.  --audit-registry checks op-metadata
@@ -122,19 +129,34 @@ def _serve(make_server, argv, label):
 
 
 def cmd_serve(argv):
-    """paddle serve --model_dir=DIR [--port=N] — HTTP inference over a
-    save_inference_model export (paddle_tpu/serving.py)."""
+    """paddle serve --model_dir=DIR [--port=N] [--request_timeout=S]
+    [--max_inflight=N] — HTTP inference over a save_inference_model
+    export (paddle_tpu/serving.py) with optional graceful-degradation
+    bounds (504 on deadline expiry, 503 on overload)."""
     from paddle_tpu.serving import InferenceServer
 
     args, _ = _kv_args(argv)
     if not args.get("model_dir"):
-        print("usage: paddle serve --model_dir=DIR [--port=N]",
+        print("usage: paddle serve --model_dir=DIR [--port=N] "
+              "[--request_timeout=SECONDS] [--max_inflight=N]",
               file=sys.stderr)
         return 2
     return _serve(
-        lambda a: InferenceServer(a["model_dir"],
-                                  port=int(a.get("port", 0))),
+        lambda a: InferenceServer(
+            a["model_dir"], port=int(a.get("port", 0)),
+            request_timeout=(float(a["request_timeout"])
+                             if a.get("request_timeout") else None),
+            max_inflight=(int(a["max_inflight"])
+                          if a.get("max_inflight") else None)),
         argv, "inference server")
+
+
+def cmd_elastic(argv):
+    """paddle elastic ... — preemption-safe demo training worker
+    (paddle_tpu/distributed/elastic.py)."""
+    from paddle_tpu.distributed.elastic import main as elastic_main
+
+    return elastic_main(argv)
 
 
 def cmd_pserver(argv):
@@ -367,6 +389,7 @@ COMMANDS = {
     "pserver": cmd_pserver,
     "master": cmd_master,
     "coord": cmd_coord,
+    "elastic": cmd_elastic,
 }
 
 

@@ -6,3 +6,5 @@ from paddle_tpu.distributed.master import MasterServer
 from paddle_tpu.distributed.master_client import MasterClient
 from paddle_tpu.distributed.pserver_client import ParameterServer, PServerClient
 from paddle_tpu.distributed.coord import CoordServer, CoordClient
+from paddle_tpu.distributed.retry import RetryPolicy, retry_call
+from paddle_tpu.distributed.elastic import DemoRegression, ElasticWorker

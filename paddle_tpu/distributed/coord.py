@@ -17,6 +17,9 @@ from __future__ import annotations
 import socket
 import threading
 import time
+from typing import Callable, Optional
+
+from paddle_tpu.distributed import retry as retry_mod
 
 
 class CoordServer:
@@ -52,22 +55,71 @@ def _hex(b: bytes) -> str:
 
 
 class CoordClient:
-    def __init__(self, addr: str):
-        host, port = addr.rsplit(":", 1)
+    """Control-plane client with reconnect-on-failure.
+
+    Transport errors (dropped TCP connection, store restart) are retried
+    under the shared :mod:`retry` policy with a fresh connection per
+    attempt — one dropped socket no longer kills the whole control
+    plane.  Store-level ``ERR`` replies raise RuntimeError immediately
+    (they are answers, not failures).  Commands are at-least-once under
+    retry: a connection that dies between send and response replays the
+    command.  PUT/DEL/KEEPALIVE replay idempotently; a replayed CAS can
+    return a *false negative* (the replay compares against its own
+    write), so CAS-based protocols must tolerate "False but it actually
+    applied" — re-read the key when the distinction matters
+    (``elect_master`` and the elastic pass barrier do).
+    """
+
+    def __init__(self, addr: str, retry: Optional[retry_mod.RetryPolicy] = None):
+        self._addr = addr
+        self._retry = retry or retry_mod.DEFAULT_POLICY
+        self._sock = None
+        self._rfile = None
+        self._lock = threading.Lock()
+        self._keepalive_stop = None
+        self._closed = False
+        with self._lock:
+            self._connect()  # fail fast on a bad address
+
+    def _connect(self):
+        host, port = self._addr.rsplit(":", 1)
         self._sock = socket.create_connection((host, int(port)))
         self._sock.setsockopt(socket.IPPROTO_TCP,
                               socket.TCP_NODELAY, 1)
         self._rfile = self._sock.makefile("rb")
-        self._lock = threading.Lock()
-        self._keepalive_stop = None
+
+    def _drop(self, _exc=None):
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._rfile.close()
+                    self._sock.close()
+                except OSError:
+                    pass
+            self._sock = None
+            self._rfile = None
 
     def _req(self, line: str) -> str:
-        with self._lock:
-            self._sock.sendall(line.encode() + b"\n")
-            resp = self._rfile.readline().decode().strip()
-        if resp.startswith("ERR"):
-            raise RuntimeError(resp)
-        return resp
+        def attempt():
+            with self._lock:
+                if self._closed:
+                    # close() is final: a racing keepalive thread must
+                    # not resurrect the connection and leak a socket
+                    raise RuntimeError("coord client is closed")
+                if self._sock is None:
+                    self._connect()
+                self._sock.sendall(line.encode() + b"\n")
+                resp = self._rfile.readline()
+                if not resp:
+                    raise ConnectionError("coord store closed connection")
+                resp = resp.decode().strip()
+            if resp.startswith("ERR"):
+                raise RuntimeError(resp)
+            return resp
+
+        return retry_mod.retry_call(
+            attempt, policy=self._retry, client="coord",
+            op=line.split(" ", 1)[0], on_retry=self._drop)
 
     # -- KV --------------------------------------------------------------
     def put(self, key: str, value: bytes, lease: int = 0) -> int:
@@ -112,15 +164,26 @@ class CoordClient:
     def revoke(self, lease_id: int):
         self._req(f"REVOKE {lease_id}")
 
-    def keepalive_loop(self, lease_id: int, period_sec: float):
-        """Background keepalive thread (the Go client's lease.KeepAlive)."""
+    def keepalive_loop(self, lease_id: int, period_sec: float,
+                       on_lost: Optional[Callable[[Exception], None]] = None):
+        """Background keepalive thread (the Go client's lease.KeepAlive).
+
+        Transient transport failures are absorbed by ``_req``'s retry
+        budget; when the lease is genuinely gone — the store replies
+        ``ERR expired`` or stays unreachable past the budget — the loop
+        *reports* via ``on_lost(exc)`` instead of silently dying, so the
+        owner can re-register (the elastic supervisor does) rather than
+        keep training on a lease the cluster already collected.
+        """
         stop = threading.Event()
 
         def _loop():
             while not stop.wait(period_sec):
                 try:
                     self.keepalive(lease_id)
-                except (RuntimeError, OSError):
+                except (RuntimeError, OSError) as e:
+                    if on_lost is not None and not stop.is_set():
+                        on_lost(e)
                     return
 
         t = threading.Thread(target=_loop, daemon=True)
@@ -162,6 +225,11 @@ class CoordClient:
         lease_id = self.lease(ttl_sec)
         if self.cas(self.MASTER_KEY, None, addr.encode(), lease=lease_id):
             return lease_id
+        # a replayed CAS after a lost response reports False for a win;
+        # revoking our lease then would delete the key we just published
+        got = self.get(self.MASTER_KEY)
+        if got is not None and got[1].decode() == addr:
+            return lease_id
         self.revoke(lease_id)
         return None
 
@@ -176,11 +244,8 @@ class CoordClient:
         return None
 
     def close(self):
-        try:
-            self._rfile.close()
-            self._sock.close()
-        except OSError:
-            pass
+        self._closed = True
+        self._drop()
 
     def __enter__(self):
         return self

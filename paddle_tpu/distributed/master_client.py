@@ -1,7 +1,13 @@
 """Trainer-side master client (reference: go/master/client.go
 Client.NextRecord / GetTask loop, surfaced in python via
 v2/master/client.py).  Speaks the line protocol of
-native/master_service.cc."""
+native/master_service.cc.
+
+Reconnect/backoff rides the shared :mod:`retry` policy (reference:
+go/connection/conn.go reconnect-with-retry), replacing the old
+hand-rolled 3-attempt loop; every reconnect shows up in the telemetry
+registry as ``rpc_retries_total{client="master"}``.
+"""
 
 from __future__ import annotations
 
@@ -9,12 +15,21 @@ import socket
 import time
 from typing import Iterator, List, Optional, Sequence
 
+from paddle_tpu.distributed import retry as retry_mod
+from paddle_tpu.observability import metrics as _metrics
+
+_M_SHARD_FAILURES = _metrics.counter(
+    "master_client_shard_failures_total",
+    "recordio shard tasks FAILTASKed by the streaming client")
+
 
 class MasterClient:
-    def __init__(self, address: str, timeout: float = 30.0):
+    def __init__(self, address: str, timeout: float = 30.0,
+                 retry: Optional[retry_mod.RetryPolicy] = None):
         host, port = address.rsplit(":", 1)
         self._addr = (host, int(port))
         self._timeout = timeout
+        self._retry = retry or retry_mod.DEFAULT_POLICY.with_(base_delay=0.2)
         self._sock: Optional[socket.socket] = None
         self._rfile = None
 
@@ -29,21 +44,19 @@ class MasterClient:
         self._rfile = s.makefile("rb")
 
     def _call(self, line: str, extra_lines: Sequence[str] = ()) -> str:
-        for attempt in range(3):
-            try:
-                self._connect()
-                payload = line + "\n" + "".join(e + "\n" for e in extra_lines)
-                self._sock.sendall(payload.encode())
-                resp = self._rfile.readline()
-                if not resp:
-                    raise ConnectionError("master closed connection")
-                return resp.decode().rstrip("\n")
-            except (OSError, ConnectionError):
-                # reconnect-with-retry (reference: go/connection/conn.go)
-                self.close()
-                if attempt == 2:
-                    raise
-                time.sleep(0.2 * (attempt + 1))
+        def attempt() -> str:
+            self._connect()
+            payload = line + "\n" + "".join(e + "\n" for e in extra_lines)
+            self._sock.sendall(payload.encode())
+            resp = self._rfile.readline()
+            if not resp:
+                raise ConnectionError("master closed connection")
+            return resp.decode().rstrip("\n")
+
+        return retry_mod.retry_call(
+            attempt, policy=self._retry, client="master",
+            op=line.split(" ", 1)[0],
+            on_retry=lambda _e: self.close())
 
     def close(self):
         if self._sock is not None:
@@ -75,11 +88,14 @@ class MasterClient:
         assert tag == "TASK", resp
         return int(tid), payload
 
-    def task_finished(self, task_id: int):
-        self._call(f"FIN {task_id}")
+    def task_finished(self, task_id: int) -> bool:
+        """False when the master no longer holds the lease (it expired
+        and the task was requeued for another worker) — the caller must
+        not treat the work as uniquely done."""
+        return self._call(f"FIN {task_id}") == "OK"
 
-    def task_failed(self, task_id: int):
-        self._call(f"FAILTASK {task_id}")
+    def task_failed(self, task_id: int) -> bool:
+        return self._call(f"FAILTASK {task_id}") == "OK"
 
     def new_pass(self):
         self._call("NEWPASS")
@@ -108,7 +124,15 @@ class MasterClient:
                 poll_interval: float = 0.1) -> Iterator[bytes]:
         """Stream records from leased recordio-shard tasks, marking tasks
         finished after their shard is fully consumed (reference:
-        go/master/client.go:240 NextRecord)."""
+        go/master/client.go:240 NextRecord).
+
+        A shard that fails to read — corrupt framing, missing file — is
+        FAILTASKed and re-leased; after the master's ``failure_max``
+        failures it is *discarded* (service.go:311 processFailedTask),
+        so one poison shard costs at most failure_max lease cycles, not
+        an infinite loop.  Only data errors are caught: anything else
+        (KeyboardInterrupt, a bug in the consumer) propagates.
+        """
         from paddle_tpu.native import RecordIOReader
 
         while True:
@@ -122,7 +146,10 @@ class MasterClient:
             try:
                 for rec in RecordIOReader(payload):
                     yield rec
-            except Exception:
+            except (OSError, ValueError):
+                # IOError (== OSError): corrupt recordio framing / CRC,
+                # unreadable file; ValueError: malformed shard payload
+                _M_SHARD_FAILURES.inc()
                 self.task_failed(tid)
                 continue
             self.task_finished(tid)

@@ -18,8 +18,11 @@ import socket
 import threading
 import zlib
 from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
 
 import numpy as np
+
+from paddle_tpu.distributed import retry as retry_mod
 
 
 class ParameterServer:
@@ -53,33 +56,68 @@ class ParameterServer:
 
 
 class _Conn:
-    def __init__(self, addr: str):
-        host, port = addr.rsplit(":", 1)
+    """One shard connection with reconnect-on-failure (shared retry
+    policy).  Delivery under retry is at-least-once — the same contract
+    as the reference Go client's Send retries (a GRAD replayed after a
+    failure that hit post-processing is one extra async-SGD gradient,
+    which async training already tolerates)."""
+
+    def __init__(self, addr: str,
+                 policy: Optional[retry_mod.RetryPolicy] = None):
+        self._addr = addr
+        self._policy = policy or retry_mod.DEFAULT_POLICY
+        self._sock = None
+        self._rfile = None
+        self._lock = threading.Lock()
+        with self._lock:
+            self._connect()  # fail fast on a bad address
+
+    def _connect(self):
+        host, port = self._addr.rsplit(":", 1)
         self._sock = socket.create_connection((host, int(port)))
         # request/response with small frames: Nagle + delayed ACK would
         # add ~40-200ms per round trip
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._rfile = self._sock.makefile("rb")
-        self._lock = threading.Lock()
+
+    def _drop(self, _exc=None):
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._rfile.close()
+                    self._sock.close()
+                except OSError:
+                    pass
+            self._sock = None
+            self._rfile = None
 
     def request(self, line: str, payload: bytes = b"",
                 want_payload: bool = False):
-        with self._lock:
-            self._sock.sendall(line.encode() + b"\n" + payload)
-            resp = self._rfile.readline().decode().strip()
-            if resp.startswith("ERR"):
-                raise RuntimeError(resp)
-            if want_payload:
-                nbytes = int(resp.split()[-1])
-                return resp, self._rfile.read(nbytes)
-            return resp, b""
+        def attempt():
+            with self._lock:
+                if self._sock is None:
+                    self._connect()
+                self._sock.sendall(line.encode() + b"\n" + payload)
+                resp = self._rfile.readline()
+                if not resp:
+                    raise ConnectionError("pserver closed connection")
+                resp = resp.decode().strip()
+                if resp.startswith("ERR"):
+                    raise RuntimeError(resp)
+                if want_payload:
+                    nbytes = int(resp.split()[-1])
+                    data = self._rfile.read(nbytes)
+                    if data is None or len(data) < nbytes:
+                        raise ConnectionError("short read from pserver")
+                    return resp, data
+                return resp, b""
+
+        return retry_mod.retry_call(
+            attempt, policy=self._policy, client="pserver",
+            op=line.split(" ", 1)[0], on_retry=self._drop)
 
     def close(self):
-        try:
-            self._rfile.close()
-            self._sock.close()
-        except OSError:
-            pass
+        self._drop()
 
 
 def _shard_of(name: str, n: int) -> int:
@@ -91,9 +129,9 @@ def _shard_of(name: str, n: int) -> int:
 class PServerClient:
     """Trainer-side client over one or more pserver shards."""
 
-    def __init__(self, addrs):
+    def __init__(self, addrs, retry: Optional[retry_mod.RetryPolicy] = None):
         self.addrs = list(addrs)
-        self._conns = [_Conn(a) for a in self.addrs]
+        self._conns = [_Conn(a, policy=retry) for a in self.addrs]
         # persistent pool: per-batch thread churn off the hot loop; more
         # workers than shards is useless (per-conn lock serializes)
         self._pool = ThreadPoolExecutor(max_workers=max(len(self._conns), 1))

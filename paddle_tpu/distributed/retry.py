@@ -1,0 +1,111 @@
+"""Unified retry/backoff policy for the distributed control plane.
+
+One policy object shared by every RPC client (CoordClient, MasterClient,
+PServerClient) and the elastic supervisor, replacing per-client
+hand-rolled loops (reference: go/connection/conn.go reconnect-with-retry
+and the Go master client's exponential backoff in
+go/master/client.go:62 launch retries).
+
+Semantics:
+
+- exponential backoff (``base_delay * multiplier**attempt``) capped at
+  ``max_delay``, with proportional random jitter so a fleet of workers
+  hitting a restarted service doesn't reconnect in lockstep;
+- an overall ``deadline`` (seconds from the first attempt) on top of the
+  attempt cap — whichever is hit first ends the retry budget;
+- only *transport* errors are retried (``retry_on``); application-level
+  errors (a store replying ``ERR ...``) propagate immediately.
+
+Every retry is visible in the PR-11 telemetry registry:
+
+- ``rpc_retries_total{client,op}``          — re-attempts after failure
+- ``rpc_retry_exhausted_total{client,op}``  — budgets that ran dry
+- ``rpc_backoff_seconds_total{client,op}``  — total time slept in backoff
+
+so ``paddle stats`` shows exactly how hard the control plane is working
+to stay connected.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, replace
+from typing import Callable, Iterator, Optional, Tuple, Type
+
+from paddle_tpu.observability import metrics as _metrics
+
+_M_RETRIES = _metrics.counter(
+    "rpc_retries_total", "RPC re-attempts after a retryable failure")
+_M_EXHAUSTED = _metrics.counter(
+    "rpc_retry_exhausted_total", "RPC calls that ran out of retry budget")
+_M_BACKOFF = _metrics.counter(
+    "rpc_backoff_seconds_total", "total seconds slept in retry backoff")
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Retry budget: attempt cap, exponential backoff shape, deadline."""
+
+    max_attempts: int = 5
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.25          # +/- fraction of the computed delay
+    deadline: Optional[float] = None   # seconds from the first attempt
+    retry_on: Tuple[Type[BaseException], ...] = (ConnectionError, OSError)
+
+    def with_(self, **kw) -> "RetryPolicy":
+        return replace(self, **kw)
+
+    def delays(self, rng: Optional[random.Random] = None) -> Iterator[float]:
+        """Backoff delay before attempt 2, 3, ... (max_attempts-1 values)."""
+        rng = rng or random
+        for i in range(max(self.max_attempts - 1, 0)):
+            d = min(self.base_delay * (self.multiplier ** i), self.max_delay)
+            if self.jitter:
+                d *= 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
+            yield max(d, 0.0)
+
+
+#: Policy used by the RPC clients unless the caller overrides it: five
+#: attempts over roughly a second — long enough to ride out a service
+#: restart, short enough not to mask a dead cluster.
+DEFAULT_POLICY = RetryPolicy()
+
+#: Patient policy for the elastic supervisor's control-plane calls: a
+#: preempted coordinator may take seconds to come back.
+SUPERVISOR_POLICY = RetryPolicy(max_attempts=8, base_delay=0.1,
+                                max_delay=3.0, deadline=30.0)
+
+
+def retry_call(fn: Callable, *args, policy: RetryPolicy = DEFAULT_POLICY,
+               client: str = "rpc", op: str = "call",
+               on_retry: Optional[Callable[[BaseException], None]] = None,
+               rng: Optional[random.Random] = None, **kwargs):
+    """Run ``fn(*args, **kwargs)`` under the policy's retry budget.
+
+    ``on_retry(exc)`` fires between attempts (clients drop their broken
+    connection there).  Raises the last error once the budget —
+    attempts or deadline — is exhausted.
+    """
+    t0 = time.monotonic()
+    delays = policy.delays(rng)
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return fn(*args, **kwargs)
+        except policy.retry_on as e:
+            delay = next(delays, None)
+            overdue = (policy.deadline is not None and
+                       time.monotonic() - t0 + (delay or 0.0)
+                       > policy.deadline)
+            if delay is None or overdue:
+                _M_EXHAUSTED.inc(client=client, op=op)
+                raise
+            if on_retry is not None:
+                on_retry(e)
+            _M_RETRIES.inc(client=client, op=op)
+            _M_BACKOFF.inc(delay, client=client, op=op)
+            time.sleep(delay)

@@ -173,14 +173,82 @@ def _program_from_dict(d) -> Program:
 # ---------------------------------------------------------------------------
 
 
-def save_checkpoint(dirname, executor=None, main_program=None, step=None,
-                    scope=None):
-    """Save every persistable var (params + optimizer state) with orbax.
-    ``step`` appends /step_N (the pass-%05d analog); returns the path."""
+def _step_dir(dirname, step) -> str:
+    return os.path.join(os.path.abspath(dirname), f"step_{int(step)}")
+
+
+def _marker_path(step_path: str) -> str:
+    # sibling file, not a file inside the orbax directory (orbax treats
+    # every entry under the step dir as part of the checkpoint tree)
+    return step_path + ".complete"
+
+
+def checkpoint_complete(dirname, step) -> bool:
+    """True when step_N was fully written (its commit marker exists)."""
+    return os.path.exists(_marker_path(_step_dir(dirname, step)))
+
+
+def save_state_tree(dirname, step, state, max_to_keep=None):
+    """Save an arbitrary pytree (dict of arrays) as step_N under
+    ``dirname`` with orbax, then commit it by writing a ``step_N.complete``
+    marker — readers (``latest_checkpoint_step``) only see marked steps,
+    so a crash mid-write can never surface a half-checkpoint.
+
+    ``max_to_keep`` prunes the oldest *complete* steps beyond the newest
+    N (the reference kept the last few pass-%05d dirs by hand); the step
+    just written always survives.  Returns the step path.
+    """
     import orbax.checkpoint as ocp
 
-    main_program = main_program or framework.default_main_program()
-    scope = scope or global_scope()
+    path = _step_dir(dirname, step)
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path, state, force=True)
+    with open(_marker_path(path), "w") as f:
+        f.write(f"{int(step)}\n")
+    if max_to_keep:
+        prune_checkpoints(dirname, max_to_keep)
+    return path
+
+
+def load_state_tree(dirname, step):
+    """Restore the pytree saved by :func:`save_state_tree`."""
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer().restore(_step_dir(dirname, step))
+
+
+def prune_checkpoints(dirname, max_to_keep):
+    """Delete all but the newest ``max_to_keep`` *complete* step_N
+    checkpoints.  Incomplete (unmarked) dirs are crash leftovers and are
+    removed too once older than the newest complete step.  Returns the
+    pruned step numbers."""
+    import shutil
+
+    if not os.path.isdir(dirname) or max_to_keep is None:
+        return []
+    complete, incomplete = [], []
+    for d in os.listdir(dirname):
+        if d.startswith("step_") and d[5:].isdigit():
+            step = int(d[5:])
+            (complete if checkpoint_complete(dirname, step)
+             else incomplete).append(step)
+    complete.sort()
+    doomed = complete[:-int(max_to_keep)] if max_to_keep > 0 else []
+    newest = complete[-1] if complete else None
+    doomed += [s for s in incomplete if newest is not None and s < newest]
+    for step in doomed:
+        path = _step_dir(dirname, step)
+        # marker first: a partially-deleted checkpoint must read as
+        # incomplete, never as the latest valid step
+        try:
+            os.remove(_marker_path(path))
+        except FileNotFoundError:
+            pass
+        shutil.rmtree(path, ignore_errors=True)
+    return sorted(doomed)
+
+
+def _collect_persistable_state(main_program, scope):
     state = {}
     for var in main_program.global_block().vars.values():
         if getattr(var, "persistable", False):
@@ -189,11 +257,24 @@ def save_checkpoint(dirname, executor=None, main_program=None, step=None,
                 v = holder.get_tensor()
                 if v is not None:
                     state[var.name] = np.asarray(v)
-    path = os.path.abspath(dirname)
+    return state
+
+
+def save_checkpoint(dirname, executor=None, main_program=None, step=None,
+                    scope=None, max_to_keep=None):
+    """Save every persistable var (params + optimizer state) with orbax.
+    ``step`` appends /step_N (the pass-%05d analog) committed atomically
+    via a ``step_N.complete`` marker, and ``max_to_keep`` bounds on-disk
+    retention (oldest complete steps pruned).  Returns the path."""
+    import orbax.checkpoint as ocp
+
+    main_program = main_program or framework.default_main_program()
+    scope = scope or global_scope()
+    state = _collect_persistable_state(main_program, scope)
     if step is not None:
-        path = os.path.join(path, f"step_{int(step)}")
-    ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(path, state, force=True)
+        return save_state_tree(dirname, step, state, max_to_keep=max_to_keep)
+    path = os.path.abspath(dirname)
+    ocp.PyTreeCheckpointer().save(path, state, force=True)
     return path
 
 
@@ -206,7 +287,7 @@ def load_checkpoint(dirname, executor=None, main_program=None, step=None,
     scope = scope or global_scope()
     path = os.path.abspath(dirname)
     if step is not None:
-        path = os.path.join(path, f"step_{int(step)}")
+        path = _step_dir(dirname, step)
     ckptr = ocp.PyTreeCheckpointer()
     state = ckptr.restore(path)
     for name, value in state.items():
@@ -215,9 +296,12 @@ def load_checkpoint(dirname, executor=None, main_program=None, step=None,
 
 
 def latest_checkpoint_step(dirname):
-    """Highest step_N under dirname, or None (resume discovery)."""
+    """Highest *complete* step_N under dirname, or None (resume
+    discovery).  Steps without their ``step_N.complete`` marker are
+    in-progress or torn writes and are never returned."""
     if not os.path.isdir(dirname):
         return None
     steps = [int(d[5:]) for d in os.listdir(dirname)
-             if d.startswith("step_") and d[5:].isdigit()]
+             if d.startswith("step_") and d[5:].isdigit()
+             and checkpoint_complete(dirname, int(d[5:]))]
     return max(steps) if steps else None

@@ -15,7 +15,18 @@ Endpoints:
   POST /predict          → body {"<feed>": nested-list, ...}
                            → {"outputs": [nested-list per fetch]}
 
+Graceful degradation (bounded, not unbounded thread pileup):
+  - ``max_inflight``: admission cap — requests beyond it are rejected
+    immediately with 503 instead of queueing forever;
+  - ``request_timeout``: per-request deadline — a request that cannot
+    reach the executor before it expires returns 504.  The deadline
+    bounds time spent *queued for* the executor (an XLA step already
+    running cannot be preempted mid-flight).
+  Both are counted in ``serving_rejected_total{reason=...}`` on
+  ``/metrics``.
+
 Launch:  paddle serve --model_dir=DIR [--port=N]
+                      [--request_timeout=SECONDS] [--max_inflight=N]
 """
 
 from __future__ import annotations
@@ -37,6 +48,10 @@ _M_INFLIGHT = _metrics.gauge(
     "serving_inflight_requests", "requests currently being handled")
 _M_RESPONSES = _metrics.counter(
     "serving_responses_total", "HTTP responses by status code")
+_M_REJECTED = _metrics.counter(
+    "serving_rejected_total",
+    "requests shed for graceful degradation, by reason "
+    "(overload -> 503, deadline -> 504)")
 
 
 def _jsonable(o):
@@ -51,7 +66,8 @@ def _jsonable(o):
 
 
 class InferenceServer:
-    def __init__(self, model_dir: str, port: int = 0):
+    def __init__(self, model_dir: str, port: int = 0,
+                 request_timeout: float = None, max_inflight: int = None):
         import paddle_tpu as fluid
         from paddle_tpu import executor as executor_mod
 
@@ -63,6 +79,10 @@ class InferenceServer:
             self._program, self.feed_names, self._fetches = (
                 fluid.io.load_inference_model(model_dir, self._exe))
         self._lock = threading.Lock()  # one executor, serialized steps
+        self._request_timeout = request_timeout
+        self._max_inflight = max_inflight
+        self._slots = (threading.BoundedSemaphore(max_inflight)
+                       if max_inflight else None)
 
         server = self
 
@@ -101,15 +121,28 @@ class InferenceServer:
                 if self.path != "/predict":
                     self._reply(404, {"error": "unknown path"})
                     return
+                if server._slots is not None and \
+                        not server._slots.acquire(blocking=False):
+                    # shed load at admission: a bounded 503 beats an
+                    # unbounded thread pileup behind the executor lock
+                    _M_REJECTED.inc(reason="overload")
+                    self._reply(503, {"error": "server overloaded "
+                                      f"(max_inflight={server._max_inflight})"})
+                    return
                 _M_INFLIGHT.inc()
                 ev_t0 = _EVENTS.now()
                 t0 = time.perf_counter()
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(n) or b"{}")
-                    outs = server.predict(payload)
+                    deadline = (time.monotonic() + server._request_timeout
+                                if server._request_timeout else None)
+                    outs = server.predict(payload, deadline=deadline)
                     self._reply(200, {"outputs": [_jsonable(o)
                                                   for o in outs]})
+                except TimeoutError as e:
+                    _M_REJECTED.inc(reason="deadline")
+                    self._reply(504, {"error": str(e)})
                 except (KeyError, ValueError, TypeError) as e:
                     self._reply(400, {"error": str(e)})
                 except Exception as e:  # surface, don't kill the server
@@ -117,6 +150,8 @@ class InferenceServer:
                 finally:
                     dt = time.perf_counter() - t0
                     _M_INFLIGHT.dec()
+                    if server._slots is not None:
+                        server._slots.release()
                     _M_REQ_SEC.observe(dt, endpoint="/predict")
                     _EVENTS.complete("serving.predict", ev_t0, dt,
                                      cat="serving")
@@ -135,7 +170,7 @@ class InferenceServer:
     def port(self):
         return self._httpd.server_address[1]
 
-    def predict(self, payload: dict):
+    def predict(self, payload: dict, deadline: float = None):
         # the executor casts every feed to its declared dtype
         # (_convert_feed), so raw np.asarray is enough here
         feed = {}
@@ -147,12 +182,24 @@ class InferenceServer:
         for k, v in payload.items():
             if k.endswith("@len") and k not in feed:
                 feed[k] = np.asarray(v)
+        # ``deadline`` (time.monotonic timestamp) bounds the wait for
+        # the executor: under overload, requests expire in the queue
+        # instead of stacking up behind the lock indefinitely
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not self._lock.acquire(timeout=remaining):
+                raise TimeoutError(
+                    "request deadline expired waiting for the executor")
+        else:
+            self._lock.acquire()
         # pass the scope explicitly: scope_guard would mutate the
         # process-global scope stack from this handler thread
-        with self._lock:
+        try:
             outs = self._exe.run(self._program, feed=feed,
                                  fetch_list=self._fetches,
                                  scope=self._scope)
+        finally:
+            self._lock.release()
         return list(outs)
 
     def stop(self):

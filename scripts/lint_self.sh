@@ -26,9 +26,10 @@ echo "== paddle stats: telemetry registry smoke"
 $PADDLE stats --json > /dev/null
 $PADDLE stats > /dev/null
 
-echo "== ruff: paddle_tpu/analysis + paddle_tpu/observability"
+echo "== ruff: analysis + observability + distributed fault-tolerance"
 if command -v ruff >/dev/null 2>&1; then
-    ruff check paddle_tpu/analysis/ paddle_tpu/observability/
+    ruff check paddle_tpu/analysis/ paddle_tpu/observability/ \
+        paddle_tpu/distributed/elastic.py paddle_tpu/distributed/retry.py
 else
     echo "ruff not installed; skipping style pass"
 fi

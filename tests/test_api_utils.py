@@ -236,3 +236,46 @@ def test_swig_parameter_and_optimizer():
                                w0 - 0.1 * g, rtol=1e-6)
     with pytest.raises(api.UnsupportError):
         api.ParameterOptimizer.create("type=bogus lr=1").init(w0)
+
+
+def test_checkpoint_complete_marker_hides_torn_writes(tmp_path):
+    """latest_checkpoint_step must never surface a partially-written
+    step: only steps with their .complete marker count (ISSUE 12
+    satellite)."""
+    import os
+
+    x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    ck = str(tmp_path / "ck")
+    fluid.io.save_checkpoint(ck, step=1)
+    assert fluid.io.checkpoint_complete(ck, 1)
+    assert fluid.io.latest_checkpoint_step(ck) == 1
+    # a torn write: the step dir exists but the commit marker does not
+    os.makedirs(os.path.join(ck, "step_7"))
+    assert not fluid.io.checkpoint_complete(ck, 7)
+    assert fluid.io.latest_checkpoint_step(ck) == 1
+    # deleting the marker makes a previously-good step invisible too
+    os.remove(os.path.join(ck, "step_1.complete"))
+    assert fluid.io.latest_checkpoint_step(ck) is None
+
+
+def test_checkpoint_max_to_keep_prunes_oldest(tmp_path):
+    import os
+
+    x = fluid.layers.data(name="x", shape=[2], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    ck = str(tmp_path / "ck")
+    for step in range(1, 6):
+        fluid.io.save_checkpoint(ck, step=step, max_to_keep=2)
+    steps = sorted(int(d[5:]) for d in os.listdir(ck)
+                   if d.startswith("step_") and d[5:].isdigit())
+    assert steps == [4, 5]          # oldest complete steps pruned
+    assert fluid.io.latest_checkpoint_step(ck) == 5
+    # markers pruned alongside their dirs
+    assert not os.path.exists(os.path.join(ck, "step_1.complete"))
+    # the survivors still restore
+    assert fluid.io.load_checkpoint(ck, step=5)

@@ -127,3 +127,45 @@ def test_master_election_single_winner():
         assert loser.elect_master("m3:7000") is not None
         assert loser.master_addr() == "m3:7000"
         c1.close(); c2.close()
+
+
+def test_pserver_slot_freed_by_ttl_expiry_and_reclaimed():
+    """Churn without a clean revoke: the claim lease simply lapses (the
+    SIGKILL case) and the index slot frees itself; a replacement
+    pserver reclaims the same slot (ISSUE 12 satellite)."""
+    with CoordServer() as s:
+        c1 = CoordClient(s.address)
+        idx, _lease = c1.register_pserver("old:1", num_pservers=1, ttl_sec=1)
+        assert idx == 0
+        c1.close()          # crash: nobody keeps the lease alive
+        deadline = time.time() + 5
+        c2 = CoordClient(s.address)
+        while c2.pserver_addrs(1) and time.time() < deadline:
+            time.sleep(0.1)
+        assert c2.pserver_addrs(1) == {}   # TTL expiry freed the slot
+        idx2, _ = c2.register_pserver("new:2", num_pservers=1, ttl_sec=5)
+        assert idx2 == 0
+        assert c2.pserver_addrs(1)[0] == "new:2"
+        c2.close()
+
+
+def test_master_reelection_after_lease_lapse():
+    """The holder dies without revoking; once its TTL lapses the key
+    frees and a standby wins the election (go/master/etcd_client.go
+    semantics under churn)."""
+    with CoordServer() as s:
+        holder = CoordClient(s.address)
+        assert holder.elect_master("m1:7000", ttl_sec=1) is not None
+        standby = CoordClient(s.address)
+        assert standby.elect_master("m2:7000", ttl_sec=5) is None  # occupied
+        holder.close()      # crash: lease never refreshed again
+        deadline = time.time() + 5
+        won = None
+        while time.time() < deadline:
+            won = standby.elect_master("m2:7000", ttl_sec=5)
+            if won is not None:
+                break
+            time.sleep(0.1)
+        assert won is not None, "standby never won after lease lapse"
+        assert standby.master_addr() == "m2:7000"
+        standby.close()

@@ -1,0 +1,296 @@
+"""Elastic fault-tolerance tests (paddle_tpu/distributed/elastic.py).
+
+The capability column ROADMAP asked for: kill a worker mid-epoch and
+show training resumes with identical final loss (reference model: the
+Go master's etcd-backed recovery contract, go/master/service.go
+snapshot/recover + TTL task leases).
+
+Fast tests exercise the supervisor in-process (simulated preemption);
+the real SIGKILL-a-subprocess run is ``slow`` so the tier-1 window does
+not grow.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import (CoordClient, CoordServer, ElasticWorker,
+                                    MasterServer)
+from paddle_tpu.distributed.elastic import DemoRegression
+from paddle_tpu.observability import metrics as _metrics
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _counter(name, **labels):
+    m = _metrics.REGISTRY.get(name)
+    return m.value(**labels) if m is not None else 0.0
+
+
+# -- happy path -------------------------------------------------------------
+
+
+def test_single_worker_matches_oracle(tmp_path):
+    demo = DemoRegression()
+    with CoordServer() as cs, MasterServer(lease_sec=10) as ms:
+        w = ElasticWorker(cs.address, job="j1", step_fn=demo.step,
+                          state=demo.init_state(), worker_id="w-a",
+                          checkpoint_dir=str(tmp_path), checkpoint_period=2,
+                          master_addr=ms.address)
+        w.start()
+        state = w.run(num_passes=3, tasks=demo.tasks(8))
+        w.stop()
+    oracle = demo.oracle(8, 3)
+    np.testing.assert_array_equal(state["w"], oracle["w"])
+    assert w.step == 24
+    assert _counter("elastic_checkpoint_commits_total", worker="w-a") > 0
+
+
+def test_master_discovery_via_coord_election(tmp_path):
+    demo = DemoRegression()
+    with CoordServer() as cs, MasterServer(lease_sec=10) as ms:
+        pub = CoordClient(cs.address)
+        assert pub.elect_master(ms.address) is not None
+        w = ElasticWorker(cs.address, job="j2", step_fn=demo.step,
+                          state=demo.init_state(),
+                          checkpoint_dir=str(tmp_path))
+        w.start()     # no explicit master_addr: discovered via coord
+        state = w.run(num_passes=1, tasks=demo.tasks(4))
+        w.stop()
+        pub.close()
+    np.testing.assert_array_equal(state["w"], demo.oracle(4, 1)["w"])
+
+
+# -- preemption + recovery --------------------------------------------------
+
+
+def test_preempted_worker_recovery_is_bit_exact(tmp_path):
+    """Kill worker A mid-pass (simulated preemption: lease collected,
+    sockets torn down, in-flight task abandoned); replacement worker B
+    must restore the last committed params+queue cut and finish with a
+    trajectory identical to the unkilled oracle."""
+    demo = DemoRegression()
+    boom = {"n": 0}
+
+    def dying_step(state, payload):
+        boom["n"] += 1
+        if boom["n"] > 5:
+            raise KeyboardInterrupt("preempted")
+        return demo.step(state, payload)
+
+    with CoordServer() as cs, MasterServer(lease_sec=10) as ms:
+        a = ElasticWorker(cs.address, job="j3", step_fn=dying_step,
+                          state=demo.init_state(), worker_id="w-a",
+                          checkpoint_dir=str(tmp_path), checkpoint_period=2,
+                          master_addr=ms.address, lease_ttl=2)
+        a.start()
+        with pytest.raises(KeyboardInterrupt):
+            a.run(num_passes=3, tasks=demo.tasks(8))
+        a.simulate_preemption()
+
+        b = ElasticWorker(cs.address, job="j3", step_fn=demo.step,
+                          state=demo.init_state(), worker_id="w-b",
+                          checkpoint_dir=str(tmp_path), checkpoint_period=2,
+                          master_addr=ms.address, lease_ttl=2)
+        b.start()
+        state = b.run(num_passes=3)   # dataset already seeded by A
+        b.stop()
+
+    oracle = demo.oracle(8, 3)
+    np.testing.assert_array_equal(state["w"], oracle["w"])
+    # the recovery machinery actually fired, and is visible in the
+    # registry `paddle stats` renders
+    assert _counter("elastic_lease_expiries_observed_total",
+                    worker="w-b") == 1
+    assert _counter("elastic_checkpoint_restores_total", worker="w-b") == 1
+    assert _counter("elastic_master_recovers_total", worker="w-b") == 1
+    assert _counter("elastic_recovered_tasks_total", worker="w-b") > 0
+    from paddle_tpu.observability import format_snapshot
+
+    table = format_snapshot(_metrics.snapshot())
+    assert "elastic_master_recovers_total" in table
+
+
+def test_checkpoint_manifest_commits_params_and_snap_together(tmp_path):
+    demo = DemoRegression()
+    with CoordServer() as cs, MasterServer(lease_sec=10) as ms:
+        w = ElasticWorker(cs.address, job="j4", step_fn=demo.step,
+                          state=demo.init_state(), worker_id="w-a",
+                          checkpoint_dir=str(tmp_path), checkpoint_period=1,
+                          master_addr=ms.address)
+        w.start()
+        w.run(num_passes=1, tasks=demo.tasks(4))
+        got = w._coord.get("/elastic/j4/manifest")
+        assert got is not None
+        man = json.loads(got[1].decode())
+        w.stop()
+    # the CAS'd manifest names a *complete* params step and a snapshot
+    # that both exist on disk — never one without the other
+    from paddle_tpu import io as io_mod
+
+    assert man["step"] == 4
+    assert io_mod.checkpoint_complete(os.path.join(str(tmp_path), "params"),
+                                      man["step"])
+    assert os.path.exists(man["snap"])
+    restored = io_mod.load_state_tree(os.path.join(str(tmp_path), "params"),
+                                      man["step"])
+    np.testing.assert_array_equal(restored["w"], demo.oracle(4, 1)["w"])
+
+
+def test_keepalive_loss_is_reported_and_worker_reregisters():
+    demo = DemoRegression()
+    with CoordServer() as cs, MasterServer(lease_sec=10) as ms:
+        w = ElasticWorker(cs.address, job="j5", step_fn=demo.step,
+                          state=demo.init_state(), worker_id="w-a",
+                          master_addr=ms.address, lease_ttl=2,
+                          keepalive_period=0.2)
+        w.start()
+        # collect the lease behind the worker's back (network partition /
+        # store-side GC): the keepalive loop must REPORT, not vanish
+        saboteur = CoordClient(cs.address)
+        saboteur.revoke(w._lease_id)
+        assert w._lease_lost.wait(timeout=5.0)
+        assert _counter("elastic_lease_lost_total", worker="w-a") == 1
+        assert saboteur.get("/elastic/j5/workers/w-a") is None
+        # the run loop re-registers on the next iteration; drive the
+        # same path directly
+        w._reregister()
+        assert saboteur.get("/elastic/j5/workers/w-a") is not None
+        assert _counter("elastic_reregistrations_total", worker="w-a") == 1
+        saboteur.close()
+        w.stop()
+
+
+def test_surviving_worker_finishes_pass_after_peer_death(tmp_path):
+    """Two-worker mode: A dies holding a leased task; the master's TTL
+    requeues it and the surviving worker B completes the pass (at-least-
+    once completion, no RECOVER since B is live)."""
+    demo = DemoRegression()
+    boom = {"n": 0}
+
+    def dying_step(state, payload):
+        boom["n"] += 1
+        if boom["n"] > 2:
+            raise KeyboardInterrupt("preempted mid-task")
+        return demo.step(state, payload)
+
+    with CoordServer() as cs, MasterServer(lease_sec=1) as ms:
+        a = ElasticWorker(cs.address, job="j6", step_fn=dying_step,
+                          state=demo.init_state(), worker_id="w-a",
+                          master_addr=ms.address, lease_ttl=2)
+        a.start()
+        tasks = demo.tasks(6)
+        with pytest.raises(KeyboardInterrupt):
+            a.run(num_passes=1, tasks=tasks)   # dies holding task #3
+        b = ElasticWorker(cs.address, job="j6", step_fn=demo.step,
+                          state=demo.init_state(), worker_id="w-b",
+                          master_addr=ms.address, lease_ttl=2)
+        b.start()   # A's worker key still live: B joins, doesn't rewind
+        b.run(num_passes=1, tasks=tasks)
+        stats = b._master.stats()
+        a.simulate_preemption()
+        b.stop()
+    # every task is in done exactly once: A's finished ones + B's,
+    # including the one A died holding (requeued by lease expiry)
+    assert stats == {"todo": 0, "pending": 0, "done": len(tasks),
+                     "discarded": 0}
+    assert boom["n"] == 3 and b.step == len(tasks) - 2
+
+
+# -- the real thing: SIGKILL a worker subprocess ----------------------------
+
+
+def _spawn_worker(coord, master, ckpt_dir, worker_id, stats_out=None,
+                  tasks=8, passes=4):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO_ROOT + os.pathsep +
+               os.environ.get("PYTHONPATH", ""))
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.elastic",
+           f"--coord={coord}", f"--master={master}", "--job=kill",
+           f"--checkpoint-dir={ckpt_dir}", f"--tasks={tasks}",
+           f"--passes={passes}", "--task-sleep=0.15", "--lease-ttl=2",
+           "--checkpoint-period=1", f"--worker-id={worker_id}"]
+    if stats_out:
+        cmd.append(f"--stats-out={stats_out}")
+    return subprocess.Popen(cmd, env=env, cwd=REPO_ROOT,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+
+
+@pytest.mark.slow
+def test_sigkill_worker_mid_epoch_resumes_with_identical_loss(tmp_path):
+    """The acceptance artifact: SIGKILL a worker subprocess mid-epoch;
+    a replacement resumes from the last committed checkpoint+snapshot
+    and the final loss is allclose to an unkilled single-worker oracle,
+    with the recovery counters visible in `paddle stats` output."""
+    from paddle_tpu import io as io_mod
+
+    tasks, passes = 8, 4
+    ck = str(tmp_path / "ck")
+    stats_json = str(tmp_path / "stats.json")
+    with CoordServer() as cs, MasterServer(lease_sec=2) as ms:
+        probe = CoordClient(cs.address)
+        a = _spawn_worker(cs.address, ms.address, ck, "w-a",
+                          tasks=tasks, passes=passes)
+        try:
+            # wait for a mid-epoch commit (a couple of tasks into pass 0
+            # of tasks*passes total), then kill -9
+            deadline = time.time() + 120
+            step = None
+            while time.time() < deadline:
+                got = probe.get("/elastic/kill/manifest")
+                if got is not None:
+                    step = json.loads(got[1].decode())["step"]
+                    if step >= 2:
+                        break
+                time.sleep(0.05)
+            assert step is not None and step >= 2, "no checkpoint committed"
+            assert a.poll() is None, a.communicate()
+            a.send_signal(signal.SIGKILL)
+            a.wait(timeout=30)
+        finally:
+            if a.poll() is None:
+                a.kill()
+        # the cluster notices the death only through the lease lapsing
+        deadline = time.time() + 30
+        while probe.get("/elastic/kill/workers/w-a") is not None:
+            assert time.time() < deadline, "worker lease never expired"
+            time.sleep(0.1)
+
+        b = _spawn_worker(cs.address, ms.address, ck, "w-b",
+                          stats_out=stats_json, tasks=tasks, passes=passes)
+        out, err = b.communicate(timeout=300)
+        assert b.returncode == 0, (out, err)
+
+        man = json.loads(probe.get("/elastic/kill/manifest")[1].decode())
+        probe.close()
+
+    demo = DemoRegression()
+    oracle = demo.oracle(tasks, passes)
+    final = io_mod.load_state_tree(os.path.join(ck, "params"), man["step"])
+    assert man["step"] == tasks * passes
+    np.testing.assert_allclose(final["w"], oracle["w"], rtol=0, atol=0)
+    assert np.isclose(demo.loss(final), demo.loss(oracle))
+
+    # recovery counters made it into the worker's telemetry snapshot...
+    snap = json.load(open(stats_json))
+    for name in ("elastic_checkpoint_restores_total",
+                 "elastic_master_recovers_total",
+                 "elastic_recovered_tasks_total",
+                 "elastic_lease_expiries_observed_total",
+                 "elastic_checkpoint_commits_total"):
+        assert name in snap, sorted(snap)
+    # ...and `paddle stats --file` renders them
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "paddle"),
+         "stats", f"--file={stats_json}"],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stderr
+    assert "elastic_master_recovers_total" in r.stdout
+    assert "elastic_checkpoint_restores_total" in r.stdout

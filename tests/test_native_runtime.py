@@ -251,3 +251,55 @@ def test_v2_master_client_namespace():
             c.close()
         finally:
             del os.environ["PADDLE_MASTER"]
+
+
+def test_records_discards_poison_shard_after_failure_max(tmp_path):
+    """A corrupt recordio shard must cost at most failure_max lease
+    cycles before the master discards it — not an infinite
+    FAILTASK/re-lease loop (ISSUE 12 satellite; service.go:311
+    processFailedTask discard semantics through the streaming client)."""
+    from paddle_tpu.observability import metrics as _metrics
+
+    good = []
+    for s in range(2):
+        p = str(tmp_path / f"good-{s}.rio")
+        with RecordIOWriter(p) as w:
+            for i in range(20):
+                w.write(f"g{s}:{i}".encode())
+        good.append(p)
+    poison = str(tmp_path / "poison.rio")
+    with RecordIOWriter(poison) as w:
+        for i in range(20):
+            w.write(f"p:{i}".encode())
+    raw = bytearray(open(poison, "rb").read())
+    raw[-1] ^= 0xFF   # corrupt the tail record's payload
+    open(poison, "wb").write(bytes(raw))
+
+    with MasterServer(lease_sec=30, failure_max=2) as srv:
+        c = MasterClient(srv.address)
+        c.set_dataset(good + [poison])
+        got = list(c.records())   # must terminate (ALL_DONE), not loop
+        stats = c.stats()
+        c.close()
+    want_good = {f"g{s}:{i}".encode() for s in range(2) for i in range(20)}
+    assert want_good <= set(got)
+    assert stats["discarded"] == 1 and stats["done"] == 2
+    # FAILTASKed exactly failure_max times, each one counted
+    assert _metrics.REGISTRY.get(
+        "master_client_shard_failures_total").value() == 2
+
+
+def test_records_propagates_non_data_errors(tmp_path):
+    """Only shard/data errors are swallowed into FAILTASK; a consumer
+    bug (or KeyboardInterrupt) must propagate, not poison the queue."""
+    p = str(tmp_path / "one.rio")
+    with RecordIOWriter(p) as w:
+        w.write(b"rec")
+    with MasterServer(lease_sec=30, failure_max=2) as srv:
+        c = MasterClient(srv.address)
+        c.set_dataset([p])
+        with pytest.raises(KeyError):
+            for _rec in c.records():
+                raise KeyError("consumer bug")
+        assert c.stats()["discarded"] == 0
+        c.close()

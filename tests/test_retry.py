@@ -1,0 +1,184 @@
+"""Unified retry/backoff policy tests (paddle_tpu/distributed/retry.py)
+and its adoption by the control-plane clients: one dropped TCP
+connection or a restarted service must not kill a training run
+(reference: go/connection/conn.go reconnect-with-retry)."""
+
+import pytest
+
+from paddle_tpu.distributed import retry as retry_mod
+from paddle_tpu.distributed import (CoordClient, CoordServer, MasterClient,
+                                    MasterServer)
+from paddle_tpu.observability import metrics as _metrics
+
+FAST = retry_mod.RetryPolicy(max_attempts=4, base_delay=0.002,
+                             max_delay=0.01, jitter=0.0)
+
+
+def test_policy_backoff_sequence_exponential_and_capped():
+    p = retry_mod.RetryPolicy(max_attempts=5, base_delay=0.1,
+                              multiplier=2.0, max_delay=0.3, jitter=0.0)
+    assert list(p.delays()) == [0.1, 0.2, 0.3, 0.3]
+
+
+def test_policy_jitter_spreads_delays():
+    import random
+
+    p = retry_mod.RetryPolicy(max_attempts=3, base_delay=1.0, jitter=0.5)
+    d1 = list(p.delays(random.Random(1)))
+    d2 = list(p.delays(random.Random(2)))
+    assert d1 != d2
+    for d in d1 + d2:
+        assert 0.5 <= d <= 1.5 or 1.0 <= d <= 3.0  # within +/- jitter band
+
+
+def test_retry_call_retries_then_succeeds_with_metrics():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    assert retry_mod.retry_call(flaky, policy=FAST, client="t",
+                                op="flaky") == "ok"
+    assert calls["n"] == 3
+    assert _metrics.REGISTRY.get("rpc_retries_total").value(
+        client="t", op="flaky") == 2
+    assert _metrics.REGISTRY.get("rpc_retry_exhausted_total").value(
+        client="t", op="flaky") == 0
+
+
+def test_retry_call_application_errors_not_retried():
+    calls = {"n": 0}
+
+    def app_error():
+        calls["n"] += 1
+        raise RuntimeError("ERR bad-request")
+
+    with pytest.raises(RuntimeError):
+        retry_mod.retry_call(app_error, policy=FAST, client="t", op="app")
+    assert calls["n"] == 1
+
+
+def test_retry_exhausted_raises_last_error_and_counts():
+    calls = {"n": 0}
+
+    def always_down():
+        calls["n"] += 1
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        retry_mod.retry_call(always_down, policy=FAST, client="t", op="down")
+    assert calls["n"] == FAST.max_attempts
+    assert _metrics.REGISTRY.get("rpc_retry_exhausted_total").value(
+        client="t", op="down") == 1
+
+
+def test_retry_deadline_bounds_total_budget():
+    import time
+
+    p = retry_mod.RetryPolicy(max_attempts=1000, base_delay=0.02,
+                              multiplier=1.0, jitter=0.0, deadline=0.1)
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError):
+        retry_mod.retry_call(lambda: (_ for _ in ()).throw(
+            ConnectionError("down")), policy=p, client="t", op="deadline")
+    assert time.monotonic() - t0 < 2.0  # nowhere near 1000 attempts
+
+
+def test_on_retry_hook_fires_between_attempts():
+    seen = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ConnectionError("once")
+        return calls["n"]
+
+    assert retry_mod.retry_call(flaky, policy=FAST, client="t", op="hook",
+                                on_retry=seen.append) == 2
+    assert len(seen) == 1 and isinstance(seen[0], ConnectionError)
+
+
+# -- client adoption: survive a service restart -----------------------------
+
+
+def _patient():
+    return retry_mod.RetryPolicy(max_attempts=10, base_delay=0.05,
+                                 max_delay=0.3, jitter=0.1)
+
+
+def test_master_client_survives_master_restart():
+    srv = MasterServer()
+    port = srv.port
+    c = MasterClient(srv.address, retry=_patient())
+    assert c.ping()
+    # drop the client's socket first: server shutdown joins per-conn
+    # threads, which sit in recv until the peer closes (same contract
+    # as every other server test in the suite)
+    c.close()
+    srv.stop()                       # control plane drops mid-run
+    # restart the service on the same address *after a delay*: the
+    # client's first attempts fail and must ride the backoff schedule
+    # instead of raising (the old behavior after its 3 fixed tries)
+    import threading
+    import time
+
+    holder = {}
+
+    def _restart():
+        time.sleep(0.4)
+        holder["srv"] = MasterServer(port=port)
+
+    t = threading.Thread(target=_restart)
+    t.start()
+    try:
+        assert c.ping()              # blocks through ~3+ backoff rounds
+        c.set_dataset(["a", "b"])
+        assert c.stats()["todo"] == 2
+        assert _metrics.REGISTRY.get("rpc_retries_total").value(
+            client="master", op="PING") >= 1
+    finally:
+        t.join()
+        c.close()
+        holder["srv"].stop()
+
+
+def test_coord_client_reconnects_after_store_restart():
+    srv = CoordServer()
+    port = srv.port
+    c = CoordClient(srv.address, retry=_patient())
+    c.put("k", b"v1")
+    c._drop()   # release the server-side conn thread before stopping
+    srv.stop()
+    srv2 = CoordServer(port=port)
+    try:
+        # the store is fresh (in-memory), but the *client* survives: the
+        # request rides a new connection instead of raising
+        c.put("k", b"v2")
+        assert c.get("k")[1] == b"v2"
+    finally:
+        c.close()
+        srv2.stop()
+
+
+def test_pserver_client_retries_connection_drop():
+    import numpy as np
+
+    from paddle_tpu.distributed import ParameterServer, PServerClient
+
+    with ParameterServer() as ps:
+        c = PServerClient([ps.address], retry=_patient())
+        try:
+            c.init_param("w", np.zeros(2, np.float32),
+                         optimizer="type=sgd lr=1.0")
+            c.finish_init()
+            # sever the transport behind the client's back; the next
+            # request must reconnect, not raise
+            c._conns[0]._sock.close()
+            c.send_grad("w", np.ones(2, np.float32))
+            np.testing.assert_allclose(c.get_param("w"), [-1.0, -1.0])
+        finally:
+            c.close()

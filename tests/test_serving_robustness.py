@@ -1,0 +1,118 @@
+"""Serving graceful-degradation tests: per-request deadline (504) and
+bounded in-flight admission (503) instead of unbounded thread pileup
+behind the executor lock (ISSUE 12 satellite; counters on /metrics)."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.serving import InferenceServer
+
+
+@pytest.fixture
+def model_dir(tmp_path):
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.fc(input=x, size=2, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.io.save_inference_model(str(tmp_path / "model"), ["x"], [y], exe)
+    return str(tmp_path / "model")
+
+
+def _post(addr, payload, timeout=30):
+    req = urllib.request.Request(
+        f"http://{addr}/predict", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(addr, path):
+    with urllib.request.urlopen(f"http://{addr}{path}", timeout=30) as r:
+        return r.read().decode()
+
+
+def test_predict_works_within_bounds(model_dir):
+    srv = InferenceServer(model_dir, request_timeout=30.0, max_inflight=4)
+    try:
+        code, body = _post(srv.address, {"x": [[1.0, 2.0, 3.0, 4.0]]})
+        assert code == 200
+        assert np.asarray(body["outputs"][0]).shape == (1, 2)
+    finally:
+        srv.stop()
+
+
+def test_deadline_expiry_returns_504_and_counts(model_dir):
+    srv = InferenceServer(model_dir, request_timeout=0.2)
+    try:
+        # warm the compile cache so the stall below is the only delay
+        assert _post(srv.address, {"x": [[0.0] * 4]})[0] == 200
+        # stall the executor: the request expires in the queue
+        srv._lock.acquire()
+        try:
+            code, body = _post(srv.address, {"x": [[1.0] * 4]})
+        finally:
+            srv._lock.release()
+        assert code == 504
+        assert "deadline" in body["error"]
+        metrics = _get(srv.address, "/metrics")
+        assert 'serving_rejected_total{reason="deadline"} 1' in metrics
+        # service recovers once the executor frees up
+        assert _post(srv.address, {"x": [[1.0] * 4]})[0] == 200
+    finally:
+        srv.stop()
+
+
+def test_overload_returns_503_and_counts(model_dir):
+    srv = InferenceServer(model_dir, request_timeout=5.0, max_inflight=1)
+    try:
+        assert _post(srv.address, {"x": [[0.0] * 4]})[0] == 200
+        srv._lock.acquire()   # hold the executor so one request queues
+        results = {}
+
+        def occupant():
+            results["first"] = _post(srv.address, {"x": [[1.0] * 4]})
+
+        t = threading.Thread(target=occupant)
+        t.start()
+        # wait until the occupant holds the single in-flight slot
+        deadline = 50
+        import time
+
+        for _ in range(deadline * 10):
+            if srv._slots._value == 0:  # noqa: SLF001 - observing the cap
+                break
+            time.sleep(0.1)
+        assert srv._slots._value == 0
+        code, body = _post(srv.address, {"x": [[2.0] * 4]})
+        assert code == 503
+        assert "overloaded" in body["error"]
+        srv._lock.release()
+        t.join(timeout=30)
+        assert results["first"][0] == 200   # queued request completed
+        metrics = _get(srv.address, "/metrics")
+        assert 'serving_rejected_total{reason="overload"} 1' in metrics
+    finally:
+        if srv._lock.locked():
+            try:
+                srv._lock.release()
+            except RuntimeError:
+                pass
+        srv.stop()
+
+
+def test_bounds_off_by_default(model_dir):
+    srv = InferenceServer(model_dir)
+    try:
+        assert srv._request_timeout is None and srv._slots is None
+        assert _post(srv.address, {"x": [[1.0] * 4]})[0] == 200
+    finally:
+        srv.stop()
