@@ -11,10 +11,13 @@ Subcommands:
        binary for the C API; here: re-parse the v1 config, load the
        pass params, export a save_inference_model directory that
        capi/paddle_tpu_capi.h consumes)
-  paddle serve --model_dir=DIR [--port=N] [--request_timeout=SECONDS]
-               [--max_inflight=N]
+  paddle serve --model_dir=DIR [--port=N] [--replicas=N] [--max_batch=N]
+               [--batch_timeout_ms=MS] [--warmup]
+               [--request_timeout=SECONDS] [--max_inflight=N]
       (HTTP JSON inference over a save_inference_model export —
-       paddle_tpu/serving.py; --request_timeout returns 504 on expiry,
+       paddle_tpu/serving: bucketed request coalescing into power-of-two
+       batch shapes + a pool of executor replicas; --warmup pre-compiles
+       the bucket ladder; --request_timeout returns 504 on expiry,
        --max_inflight sheds load with 503 instead of piling up threads)
   paddle elastic --coord=HOST:PORT --checkpoint-dir=DIR [--job=NAME]
                  [--tasks=N] [--passes=P] [--worker-id=ID] ...
@@ -129,16 +132,20 @@ def _serve(make_server, argv, label):
 
 
 def cmd_serve(argv):
-    """paddle serve --model_dir=DIR [--port=N] [--request_timeout=S]
-    [--max_inflight=N] — HTTP inference over a save_inference_model
-    export (paddle_tpu/serving.py) with optional graceful-degradation
-    bounds (504 on deadline expiry, 503 on overload)."""
+    """paddle serve --model_dir=DIR [--port=N] [--replicas=N]
+    [--max_batch=N] [--batch_timeout_ms=MS] [--warmup]
+    [--request_timeout=S] [--max_inflight=N] — HTTP inference over a
+    save_inference_model export (paddle_tpu/serving): concurrent
+    requests coalesce into power-of-two batch buckets dispatched across
+    a pool of executor replicas, with graceful-degradation bounds (504
+    on deadline expiry, 503 on overload)."""
     from paddle_tpu.serving import InferenceServer
 
-    args, _ = _kv_args(argv)
+    args, rest = _kv_args(argv)
     if not args.get("model_dir"):
         print("usage: paddle serve --model_dir=DIR [--port=N] "
-              "[--request_timeout=SECONDS] [--max_inflight=N]",
+              "[--replicas=N] [--max_batch=N] [--batch_timeout_ms=MS] "
+              "[--warmup] [--request_timeout=SECONDS] [--max_inflight=N]",
               file=sys.stderr)
         return 2
     return _serve(
@@ -147,7 +154,11 @@ def cmd_serve(argv):
             request_timeout=(float(a["request_timeout"])
                              if a.get("request_timeout") else None),
             max_inflight=(int(a["max_inflight"])
-                          if a.get("max_inflight") else None)),
+                          if a.get("max_inflight") else None),
+            replicas=int(a.get("replicas", 1)),
+            max_batch=int(a.get("max_batch", 8)),
+            batch_timeout_ms=float(a.get("batch_timeout_ms", 0.0)),
+            warmup="--warmup" in rest),
         argv, "inference server")
 
 

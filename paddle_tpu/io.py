@@ -142,19 +142,34 @@ def save_inference_model(dirname: str, feeded_var_names: Sequence[str],
     return inference_program
 
 
-def load_inference_model(dirname: str, executor):
+def read_inference_export(dirname: str):
+    """Parse a ``save_inference_model`` directory without touching any
+    scope: ``(program, feed_names, fetch_names, param_names)``.  The
+    single reader of the export layout — ``load_inference_model`` and
+    the serving engine's per-replica param loads both go through it."""
     with open(os.path.join(dirname, "__model__.json")) as f:
         meta = json.load(f)
     program = _program_from_dict(meta["program"])
-    # load params into scope
-    scope = global_scope()
     manifest_path = os.path.join(dirname, "MANIFEST.json")
+    param_names = []
     if os.path.exists(manifest_path):
         with open(manifest_path) as f:
-            manifest = json.load(f)
-        for name in manifest["vars"]:
-            scope.set(name, np.load(os.path.join(dirname, name + ".npy")))
-    return program, meta["feed_names"], meta["fetch_names"]
+            param_names = list(json.load(f)["vars"])
+    return program, meta["feed_names"], meta["fetch_names"], param_names
+
+
+def load_exported_param(dirname: str, name: str) -> np.ndarray:
+    """One parameter from a ``save_inference_model`` export."""
+    return np.load(os.path.join(dirname, name + ".npy"))
+
+
+def load_inference_model(dirname: str, executor, scope=None):
+    program, feed_names, fetch_names, param_names = \
+        read_inference_export(dirname)
+    scope = scope if scope is not None else global_scope()
+    for name in param_names:
+        scope.set(name, load_exported_param(dirname, name))
+    return program, feed_names, fetch_names
 
 
 def _program_from_dict(d) -> Program:

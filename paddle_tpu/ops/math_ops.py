@@ -14,7 +14,113 @@ import jax.numpy as jnp
 
 from paddle_tpu.lod import rewrap, unwrap
 from paddle_tpu.ops.common import broadcast_to_x, elementwise, unary
-from paddle_tpu.registry import register_op
+from paddle_tpu.registry import SkipInferShape, infer_same_shape, register_op
+
+
+def _dim_known(d) -> bool:
+    return d is not None and d >= 0
+
+
+def _static_numel(shape):
+    """Product of dims, or None if any is dynamic."""
+    n = 1
+    for d in shape:
+        if not _dim_known(d):
+            return None
+        n *= d
+    return n
+
+
+def _infer_mul_shape(op, block):
+    """mul flattens X to 2-D at x_num_col_dims and Y at y_num_col_dims
+    (reference: operators/mul_op.cc InferShape): Out keeps X's leading
+    dims and Y's trailing dims.  Validates the contracted extents when
+    both are static; backfills Out's shape when missing."""
+    xv = block.find_var(op.input("X")[0]) if op.input("X") else None
+    yv = block.find_var(op.input("Y")[0]) if op.input("Y") else None
+    ov = block.find_var(op.output("Out")[0]) if op.output("Out") else None
+    if xv is None or yv is None or ov is None:
+        raise SkipInferShape
+    if xv.shape is None or yv.shape is None:
+        raise SkipInferShape
+    xn = op.attr("x_num_col_dims", 1)
+    yn = op.attr("y_num_col_dims", 1)
+    if not (0 < xn <= len(xv.shape) and 0 < yn <= len(yv.shape)):
+        raise ValueError(
+            f"num_col_dims ({xn}, {yn}) out of range for shapes "
+            f"{xv.shape} x {yv.shape}")
+    k_x = _static_numel(xv.shape[xn:])
+    k_y = _static_numel(yv.shape[:yn])
+    if k_x is not None and k_y is not None and k_x != k_y:
+        raise ValueError(
+            f"contracted extents differ: X{list(xv.shape)} flattened at "
+            f"{xn} gives K={k_x}, Y{list(yv.shape)} flattened at {yn} "
+            f"gives K={k_y}")
+    if ov.shape is None:
+        ov.shape = tuple(xv.shape[:xn]) + tuple(yv.shape[yn:])
+
+
+def _infer_matmul_shape(op, block):
+    """Batched matmul: Out is (batch..., M, N) after transpose attrs.
+    Validates the inner extents when static; backfills Out's shape."""
+    xv = block.find_var(op.input("X")[0]) if op.input("X") else None
+    yv = block.find_var(op.input("Y")[0]) if op.input("Y") else None
+    ov = block.find_var(op.output("Out")[0]) if op.output("Out") else None
+    if xv is None or yv is None or ov is None:
+        raise SkipInferShape
+    if xv.shape is None or yv.shape is None:
+        raise SkipInferShape
+    xs, ys = list(xv.shape), list(yv.shape)
+    if len(xs) < 2 or len(ys) < 2:
+        raise SkipInferShape  # 1-D operands follow numpy promotion rules
+    if op.attr("transpose_X", False):
+        xs[-1], xs[-2] = xs[-2], xs[-1]
+    if op.attr("transpose_Y", False):
+        ys[-1], ys[-2] = ys[-2], ys[-1]
+    if _dim_known(xs[-1]) and _dim_known(ys[-2]) and xs[-1] != ys[-2]:
+        raise ValueError(
+            f"inner extents differ: {xv.shape} @ {yv.shape} "
+            f"(K={xs[-1]} vs {ys[-2]})")
+    if ov.shape is None:
+        # numpy-style broadcast over the leading batch dims
+        xb, yb = xs[:-2], ys[:-2]
+        if len(xb) < len(yb):
+            xb = [1] * (len(yb) - len(xb)) + xb
+        else:
+            yb = [1] * (len(xb) - len(yb)) + yb
+        batch = []
+        for a, b in zip(xb, yb):
+            if a == 1:
+                batch.append(b)
+            elif b == 1:
+                batch.append(a)
+            elif not _dim_known(a) or not _dim_known(b):
+                batch.append(-1)
+            elif a == b:
+                batch.append(a)
+            else:
+                raise ValueError(
+                    f"batch dims do not broadcast: {xv.shape} @ {yv.shape}")
+        ov.shape = tuple(batch) + (xs[-2], ys[-1])
+
+
+def _infer_sum_shape(op, block):
+    """sum's Out mirrors the first X operand with a known shape."""
+    outs = op.output("Out")
+    if len(outs) != 1 or not outs[0]:
+        raise SkipInferShape
+    ov = block.find_var(outs[0])
+    if ov is None:
+        raise SkipInferShape
+    for name in op.input("X"):
+        xv = block.find_var(name) if name else None
+        if xv is not None and xv.shape is not None:
+            if ov.shape is None:
+                ov.shape = tuple(xv.shape)
+            if ov.lod_level == 0 and xv.lod_level:
+                ov.lod_level = xv.lod_level
+            return
+    raise SkipInferShape
 
 
 def _pref():
@@ -33,7 +139,7 @@ def _flatten2d(x, num_col_dims):
     return jnp.reshape(x, (lead, rest))
 
 
-@register_op("mul", inputs=("X", "Y"))
+@register_op("mul", inputs=("X", "Y"), infer_shape=_infer_mul_shape)
 def _mul(ctx):
     """Flattening matmul (reference: operators/mul_op.cc): X flattened to
     2-D at x_num_col_dims, Y at y_num_col_dims."""
@@ -61,7 +167,7 @@ def _mul(ctx):
     ctx.set_output("Out", rewrap(ctx.input("X"), jnp.reshape(out, out_shape)))
 
 
-@register_op("matmul", inputs=("X", "Y"))
+@register_op("matmul", inputs=("X", "Y"), infer_shape=_infer_matmul_shape)
 def _matmul(ctx):
     x = unwrap(ctx.input("X"))
     y = unwrap(ctx.input("Y"))
@@ -86,10 +192,13 @@ for name, fn in [
     ("elementwise_min", jnp.minimum),
     ("elementwise_pow", jnp.power),
 ]:
-    register_op(name, inputs=("X", "Y"))(functools.partial(lambda ctx, f: elementwise(ctx, f), f=fn))
+    # Out mirrors X: the reference broadcast rule aligns Y's dims to a
+    # run of X's, so X's shape is always the output shape
+    register_op(name, inputs=("X", "Y"), infer_shape=infer_same_shape)(
+        functools.partial(lambda ctx, f: elementwise(ctx, f), f=fn))
 
 
-@register_op("sum", inputs=("X",))
+@register_op("sum", inputs=("X",), infer_shape=_infer_sum_shape)
 def _sum(ctx):
     from paddle_tpu.sparse import SparseGrad, concat_sparse
 
@@ -107,25 +216,26 @@ def _sum(ctx):
     ctx.set_output("Out", rewrap(template, out))
 
 
-@register_op("scale", inputs=("X",))
+@register_op("scale", inputs=("X",), infer_shape=infer_same_shape)
 def _scale(ctx):
     s = ctx.attr("scale", 1.0)
     b = ctx.attr("bias", 0.0)
     unary(ctx, lambda x: x * jnp.asarray(s, x.dtype) + jnp.asarray(b, x.dtype))
 
 
-@register_op("sign", inputs=("X",), stop_gradient=True)
+@register_op("sign", inputs=("X",), stop_gradient=True,
+             infer_shape=infer_same_shape)
 def _sign(ctx):
     unary(ctx, jnp.sign)
 
 
-@register_op("clip", inputs=("X",))
+@register_op("clip", inputs=("X",), infer_shape=infer_same_shape)
 def _clip(ctx):
     lo, hi = ctx.attr("min"), ctx.attr("max")
     unary(ctx, lambda x: jnp.clip(x, lo, hi))
 
 
-@register_op("clip_by_norm", inputs=("X",))
+@register_op("clip_by_norm", inputs=("X",), infer_shape=infer_same_shape)
 def _clip_by_norm(ctx):
     max_norm = ctx.attr("max_norm")
     def f(x):
@@ -176,7 +286,8 @@ def _cos_sim(ctx):
 
 
 def _register_compare(name, fn):
-    @register_op(name, inputs=("X", "Y"), stop_gradient=True)
+    @register_op(name, inputs=("X", "Y"), stop_gradient=True,
+                 infer_shape=infer_same_shape)
     def _cmp(ctx, fn=fn):
         x = ctx.input("X")
         y = ctx.input("Y")
@@ -198,12 +309,13 @@ for name, fn in [
     _register_compare(name, fn)
 
 
-@register_op("logical_not", inputs=("X",), stop_gradient=True)
+@register_op("logical_not", inputs=("X",), stop_gradient=True,
+             infer_shape=infer_same_shape)
 def _logical_not(ctx):
     unary(ctx, jnp.logical_not)
 
 
-@register_op("minus", inputs=("X", "Y"))
+@register_op("minus", inputs=("X", "Y"), infer_shape=infer_same_shape)
 def _minus(ctx):
     x = ctx.input("X")
     ctx.set_output("Out", rewrap(x, unwrap(x) - unwrap(ctx.input("Y"))))

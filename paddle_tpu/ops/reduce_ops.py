@@ -6,17 +6,59 @@ import jax.numpy as jnp
 
 from paddle_tpu.lod import unwrap
 from paddle_tpu.ops.common import unary
-from paddle_tpu.registry import register_op
+from paddle_tpu.registry import SkipInferShape, register_op
 
 
-@register_op("mean", inputs=("X",))
+def _reduce_io_vars(op, block):
+    xs, outs = op.input("X"), op.output("Out")
+    if len(xs) != 1 or len(outs) != 1 or not xs[0] or not outs[0]:
+        raise SkipInferShape
+    xv, ov = block.find_var(xs[0]), block.find_var(outs[0])
+    if xv is None or ov is None or xv.shape is None:
+        raise SkipInferShape
+    return xv, ov
+
+
+def _infer_scalar_shape(op, block):
+    """mean / l1_norm collapse X to a rank-0 scalar."""
+    _, ov = _reduce_io_vars(op, block)
+    if ov.shape is None:
+        ov.shape = ()
+
+
+def _infer_reduce_shape(op, block):
+    """reduce_{sum,mean,max,min}: drop (or keep as 1) the reduced dim,
+    mirroring the lowering's axis semantics."""
+    xv, ov = _reduce_io_vars(op, block)
+    if ov.shape is not None:
+        return
+    keep = op.attr("keep_dim", False)
+    if op.attr("reduce_all", False):
+        ov.shape = (1,) * len(xv.shape) if keep else ()
+        return
+    dim = op.attr("dim", 0)
+    if not isinstance(dim, int):
+        raise SkipInferShape
+    ndim = len(xv.shape)
+    if not -ndim <= dim < ndim:
+        raise ValueError(f"dim {dim} out of range for shape {xv.shape}")
+    dim %= ndim
+    shape = list(xv.shape)
+    if keep:
+        shape[dim] = 1
+    else:
+        del shape[dim]
+    ov.shape = tuple(shape)
+
+
+@register_op("mean", inputs=("X",), infer_shape=_infer_scalar_shape)
 def _mean(ctx):
     x = unwrap(ctx.input("X"))
     ctx.set_output("Out", jnp.mean(x).reshape(()))
 
 
 def _reg_reduce(name, fn):
-    @register_op(name, inputs=("X",))
+    @register_op(name, inputs=("X",), infer_shape=_infer_reduce_shape)
     def _red(ctx, fn=fn):
         x = unwrap(ctx.input("X"))
         dim = ctx.attr("dim", 0)
@@ -39,6 +81,6 @@ for _n, _f in [
     _reg_reduce(_n, _f)
 
 
-@register_op("l1_norm", inputs=("X",))
+@register_op("l1_norm", inputs=("X",), infer_shape=_infer_scalar_shape)
 def _l1_norm(ctx):
     unary(ctx, lambda x: jnp.sum(jnp.abs(x)).reshape(()))

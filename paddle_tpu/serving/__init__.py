@@ -1,0 +1,319 @@
+"""HTTP model serving over a save_inference_model export — the
+continuous-batching serving engine.
+
+The 2017 reference's deployment story was the C API; this serves the
+same artifact over JSON/HTTP through a real serving stack instead of a
+single executor behind a lock:
+
+- **Bucketed request coalescing** (``paddle_tpu/serving/batching.py``):
+  concurrent ``/predict`` requests are merged into padded batches at
+  power-of-two bucket shapes — one compiled XLA program per
+  (program-fingerprint, bucket) key via the Executor compile cache, so
+  steady-state traffic never re-traces.  Results are de-padded and
+  scattered back to each waiter.  Models whose feeds/fetches are not
+  batch-major (ragged sequences, LoD outputs, reduced fetches — decided
+  from verifier shape metadata) still serve; those requests execute
+  solo at their exact shape, like the pre-batching server.
+- **Replica pool** (``paddle_tpu/serving/replica.py``): ``--replicas=N``
+  worker clones, each with its own Scope + Executor and zero shared
+  mutable state (the ``pd_machine_clone`` shape), pulling batches from
+  one queue so admission, batching, and XLA dispatch overlap.
+
+Endpoints:
+  GET  /health           → {"status": "ok", "feeds": [...], "fetches":
+                           [...], "batching": {...}}
+  GET  /metrics          → Prometheus text exposition (0.0.4): request
+                           latency histogram, in-flight gauge, status
+                           counters, serving_batch_size /
+                           serving_queue_wait_seconds, plus the
+                           executor's compile/step metrics
+  GET  /stats            → the observability registry snapshot as JSON
+                           (what `paddle stats --url=...` renders)
+  POST /predict          → body {"<feed>": nested-list, ...}
+                           → {"outputs": [nested-list per fetch]}
+                           Unknown payload keys (other than ``@len``
+                           side-feeds) are a 400 naming the key.
+
+Graceful degradation (bounded, not unbounded thread pileup):
+  - ``max_inflight``: admission cap — requests beyond it are rejected
+    immediately with 503 instead of queueing forever;
+  - ``request_timeout``: per-request deadline — a request that does not
+    complete before it expires returns 504 (and is dropped from the
+    queue without burning a dispatch if it expires while queued);
+  - clients that disconnect mid-response are counted, not crashed.
+  All are counted in ``serving_rejected_total{reason=...}`` on
+  ``/metrics`` (overload → 503, deadline → 504, client_gone).
+
+Launch:  paddle serve --model_dir=DIR [--port=N]
+                      [--replicas=N] [--max_batch=N]
+                      [--batch_timeout_ms=MS] [--warmup]
+                      [--request_timeout=SECONDS] [--max_inflight=N]
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.observability.events import GLOBAL_EVENTS as _EVENTS
+from paddle_tpu.serving.batching import (
+    BatchSpec,
+    PendingRequest,
+    RequestQueue,
+    bucket_ladder,
+    next_bucket,
+)
+from paddle_tpu.serving.replica import ModelBundle, Replica, ReplicaPool
+
+__all__ = [
+    "BatchSpec", "InferenceServer", "ModelBundle", "PendingRequest",
+    "Replica", "ReplicaPool", "RequestQueue", "bucket_ladder",
+    "next_bucket",
+]
+
+_M_REQ_SEC = _metrics.histogram(
+    "serving_request_seconds",
+    "wall time per inference request, including executor dispatch")
+_M_INFLIGHT = _metrics.gauge(
+    "serving_inflight_requests", "requests currently being handled")
+_M_RESPONSES = _metrics.counter(
+    "serving_responses_total", "HTTP responses by status code")
+_M_REJECTED = _metrics.counter(
+    "serving_rejected_total",
+    "requests shed for graceful degradation, by reason "
+    "(overload -> 503, deadline -> 504, client_gone -> disconnect)")
+
+
+def _jsonable(o):
+    """Fetch value → JSON shape; LoD outputs become
+    {"data": ..., "lod": [...]} (packed rows + offset tables)."""
+    from paddle_tpu.lod import LoDArray
+
+    if isinstance(o, LoDArray):
+        return {"data": np.asarray(o.data).tolist(),
+                "lod": [np.asarray(l).tolist() for l in o.lod]}
+    return np.asarray(o).tolist()
+
+
+class InferenceServer:
+    def __init__(self, model_dir: str, port: int = 0,
+                 request_timeout: float = None, max_inflight: int = None,
+                 replicas: int = 1, max_batch: int = 8,
+                 batch_timeout_ms: float = 0.0, warmup: bool = False,
+                 place=None):
+        self._bundle = ModelBundle(model_dir)
+        self.feed_names = self._bundle.feed_names
+        self._fetches = self._bundle.fetch_names
+        self._feed_set = frozenset(self.feed_names)
+        if max_batch > 1:
+            self._spec = self._bundle.batch_spec()
+        else:
+            self._spec = BatchSpec.disabled(
+                "coalescing off (max_batch <= 1): every request runs at "
+                "its exact feed shape")
+        self._queue = RequestQueue(max_batch=max_batch,
+                                   batch_timeout=batch_timeout_ms / 1000.0)
+        self._pool = ReplicaPool(self._bundle, self._queue, self._spec,
+                                 replicas=replicas, place=place)
+        self._request_timeout = request_timeout
+        self._max_inflight = max_inflight
+        self._slots = (threading.BoundedSemaphore(max_inflight)
+                       if max_inflight else None)
+        if warmup:
+            self._pool.warmup()
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # keep-alive: one TCP connection per load-test client, not
+            # one per request (we always send Content-Length)
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _reply(self, code, obj, ctype="application/json",
+                       raw=None):
+                body = raw if raw is not None else json.dumps(obj).encode()
+                _M_RESPONSES.inc(code=str(code))
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    # load-test client hung up mid-response: count it,
+                    # don't spam stderr or kill the handler thread
+                    _M_REJECTED.inc(reason="client_gone")
+                    self.close_connection = True
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._reply(200, {
+                        "status": "ok",
+                        "feeds": server.feed_names,
+                        "fetches": [getattr(f, "name", str(f))
+                                    for f in server._fetches],
+                        "batching": server.batching_info()})
+                elif self.path == "/metrics":
+                    self._reply(
+                        200, None,
+                        ctype="text/plain; version=0.0.4; charset=utf-8",
+                        raw=_metrics.render_prometheus().encode())
+                elif self.path == "/stats":
+                    self._reply(200, _metrics.snapshot())
+                else:
+                    self._reply(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                # always consume the body first: on keep-alive
+                # (HTTP/1.1) an unread body would be parsed as the
+                # next request line, desyncing the connection for
+                # every reply sent before rfile.read — 404s and 503s
+                # included
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    raw_body = self.rfile.read(n)
+                except (BrokenPipeError, ConnectionResetError):
+                    _M_REJECTED.inc(reason="client_gone")
+                    self.close_connection = True
+                    return
+                if self.path != "/predict":
+                    self._reply(404, {"error": "unknown path"})
+                    return
+                if server._slots is not None and \
+                        not server._slots.acquire(blocking=False):
+                    # shed load at admission: a bounded 503 beats an
+                    # unbounded request pileup in the batching queue
+                    _M_REJECTED.inc(reason="overload")
+                    self._reply(503, {"error": "server overloaded "
+                                      f"(max_inflight={server._max_inflight})"})
+                    return
+                _M_INFLIGHT.inc()
+                ev_t0 = _EVENTS.now()
+                t0 = time.perf_counter()
+                try:
+                    payload = json.loads(raw_body or b"{}")
+                    deadline = (time.monotonic() + server._request_timeout
+                                if server._request_timeout else None)
+                    outs = server.predict(payload, deadline=deadline)
+                    self._reply(200, {"outputs": [_jsonable(o)
+                                                  for o in outs]})
+                except TimeoutError as e:
+                    _M_REJECTED.inc(reason="deadline")
+                    self._reply(504, {"error": str(e)})
+                except (BrokenPipeError, ConnectionResetError):
+                    _M_REJECTED.inc(reason="client_gone")
+                    self.close_connection = True
+                except (KeyError, ValueError, TypeError) as e:
+                    self._reply(400, {"error": str(e)})
+                except Exception as e:  # surface, don't kill the server
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                finally:
+                    dt = time.perf_counter() - t0
+                    _M_INFLIGHT.dec()
+                    if server._slots is not None:
+                        server._slots.release()
+                    _M_REQ_SEC.observe(dt, endpoint="/predict")
+                    _EVENTS.complete("serving.predict", ev_t0, dt,
+                                     cat="serving")
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def address(self):
+        host, port = self._httpd.server_address[:2]
+        return f"{host}:{port}"
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1]
+
+    def batching_info(self) -> dict:
+        return {
+            "enabled": self._spec.batchable,
+            "reason": self._spec.reason,
+            "replicas": len(self._pool.replicas),
+            "max_batch": self._queue.max_batch,
+            "batch_timeout_ms": self._queue.batch_timeout * 1000.0,
+            "buckets": (list(bucket_ladder(self._queue.max_batch))
+                        if self._spec.batchable else []),
+        }
+
+    # -- serving ------------------------------------------------------------
+
+    def _build_feeds(self, payload: dict) -> dict:
+        # the executor casts every feed to its declared dtype
+        # (_convert_feed), so raw np.asarray is enough here
+        feed = {}
+        for name in self.feed_names:
+            if name not in payload:
+                raise KeyError(f"missing feed {name!r}")
+        for k, v in payload.items():
+            if k in self._feed_set or k.endswith("@len"):
+                # lengths side-feeds ride along with declared feeds
+                feed[k] = np.asarray(v)
+            else:
+                # a mis-keyed request must not silently drop data (and
+                # must never be coalesced into someone else's bucket)
+                raise ValueError(
+                    f"unknown payload key {k!r}; expected feeds "
+                    f"{sorted(self._feed_set)} (plus optional '@len' "
+                    "side-feeds)")
+        return feed
+
+    def predict(self, payload: dict, deadline: float = None):
+        """Run one request through the batching engine.  ``deadline``
+        (a ``time.monotonic`` timestamp) bounds the *whole* wait —
+        queueing and execution; an expired request raises TimeoutError
+        (504 over HTTP) instead of stacking up behind busy replicas."""
+        feed = self._build_feeds(payload)
+        info = self._spec.classify(feed)
+        if info is None:
+            req = PendingRequest(feed, rows=1, batchable=False,
+                                 deadline=deadline)
+        else:
+            rows, cast = info
+            req = PendingRequest(cast, rows=rows, batchable=True,
+                                 deadline=deadline)
+        self._queue.submit(req)
+        timeout = None
+        if deadline is not None:
+            timeout = max(0.0, deadline - time.monotonic())
+        if not req.wait(timeout):
+            req.abandoned = True
+            raise TimeoutError(
+                "request deadline expired waiting for a serving replica")
+        if req.error is not None:
+            raise req.error
+        return list(req.outputs)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def warmup(self):
+        """Pre-compile the bucket ladder on every replica."""
+        return self._pool.warmup()
+
+    def pause(self):
+        """Stop replicas taking new batches (drain/maintenance); queued
+        requests wait (and expire against their deadlines)."""
+        self._pool.pause()
+
+    def resume(self):
+        self._pool.resume()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._pool.stop()
+        self._httpd.server_close()

@@ -1,0 +1,378 @@
+"""Bucketed request coalescing for the serving engine.
+
+Continuous batching, the way a static-shape compiler wants it: concurrent
+single-row (or few-row) ``/predict`` requests are coalesced into one
+padded batch at a small set of power-of-two *bucket* shapes, so the
+Executor's compile cache holds exactly one XLA program per
+(program-fingerprint, bucket) key and steady-state traffic never
+re-traces.  The scheduling shape follows the continuous/ragged-batch
+ideas in "Ragged Paged Attention" (PAPERS.md): admission, batch
+formation, and device dispatch overlap — a worker that frees up takes
+whatever compatible requests are queued *right now* (no mandatory
+linger), so light traffic keeps single-request latency and heavy
+traffic amortizes dispatch across the batch.
+
+Pieces:
+
+- ``BatchSpec`` — the *bucketer's* static decision: does the loaded
+  program admit row coalescing at all?  It trusts verifier shape
+  metadata (``Variable.shape``/``lod_level``, backfilled by the op
+  registry's ``infer_shape`` rules — paddle_tpu/analysis registry
+  ratchet): every feed and every fetch must be batch-major
+  (leading dim -1, static trailing dims, lod_level 0).  Programs that
+  fail the test (ragged feeds, scalar/reduced fetches, LoD outputs)
+  still serve — each request just executes solo, exactly as the
+  pre-batching server did.
+- ``PendingRequest`` — one waiter: converted feeds, row span, deadline,
+  and a completion event the HTTP handler blocks on.
+- ``RequestQueue`` — the bounded coalescing queue replica workers pull
+  from: ``take()`` groups compatible pending requests up to
+  ``max_batch`` rows (optionally lingering ``batch_timeout`` seconds to
+  fill a bucket) and expires requests whose deadline passed while
+  queued.
+- ``coalesce``/``scatter`` — pad rows up to the bucket (replicating the
+  last real row, so padding can never create NaN/Inf out of thin air)
+  and slice each fetch back to the right waiter.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu.observability import metrics as _metrics
+
+_M_QUEUE_WAIT = _metrics.histogram(
+    "serving_queue_wait_seconds",
+    "time a request spends queued before a replica takes it")
+_M_BATCH_ROWS = _metrics.histogram(
+    "serving_batch_size",
+    "coalesced request rows per executed batch "
+    "(label bucket = padded rows dispatched, 'unbatched' = solo path)",
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0))
+
+
+def next_bucket(rows: int) -> int:
+    """Smallest power-of-two >= rows (the padded batch dim)."""
+    if rows <= 1:
+        return 1
+    return 1 << (rows - 1).bit_length()
+
+
+def bucket_ladder(max_batch: int) -> Tuple[int, ...]:
+    """The bucket shapes a server with this cap compiles: 1,2,4..cap."""
+    out = []
+    b = 1
+    while b < max_batch:
+        out.append(b)
+        b <<= 1
+    out.append(next_bucket(max_batch))
+    return tuple(out)
+
+
+def propagate_shapes(program) -> None:
+    """Run registered ``infer_shape`` rules over the global block so the
+    bucketer sees backfilled var metadata (a program loaded via
+    ``Program.from_dict`` skips append-time InferShape).  Rules that
+    cannot infer (``SkipInferShape``) or reject are ignored here — the
+    bucketer is conservative, not a verifier; ``paddle lint`` is."""
+    from paddle_tpu.registry import OpRegistry
+
+    block = program.global_block()
+    for op in block.ops:
+        if op.type in ("feed", "fetch"):
+            continue
+        info = OpRegistry.get(op.type, none_ok=True)
+        if info is None or info.infer_shape is None:
+            continue
+        try:
+            info.infer_shape(op, block)
+        except Exception:
+            continue
+    program.invalidate_cache()
+
+
+class BatchSpec:
+    """Static batchability decision + per-feed row layout."""
+
+    def __init__(self, batchable: bool, reason: str,
+                 feed_names: Sequence[str] = (),
+                 row_shapes: Optional[Dict[str, tuple]] = None,
+                 dtypes: Optional[Dict[str, Any]] = None):
+        self.batchable = batchable
+        self.reason = reason
+        self.feed_names = tuple(feed_names)
+        self.row_shapes = row_shapes or {}
+        self.dtypes = dtypes or {}
+        self._feed_set = frozenset(self.feed_names)
+
+    @classmethod
+    def disabled(cls, reason: str) -> "BatchSpec":
+        return cls(False, reason)
+
+    @classmethod
+    def from_program(cls, program, feed_names: Sequence[str],
+                     fetch_names: Sequence[str]) -> "BatchSpec":
+        propagate_shapes(program)
+        block = program.global_block()
+        row_shapes: Dict[str, tuple] = {}
+        dtypes: Dict[str, Any] = {}
+        for name in feed_names:
+            var = block.find_var(name)
+            if var is None or var.shape is None:
+                return cls.disabled(f"feed {name!r} has no shape metadata")
+            if var.lod_level:
+                return cls.disabled(f"feed {name!r} is LoD "
+                                    f"(lod_level={var.lod_level})")
+            if len(var.shape) < 1 or var.shape[0] != -1:
+                return cls.disabled(
+                    f"feed {name!r} shape {var.shape} is not batch-major")
+            if any(d < 0 for d in var.shape[1:]):
+                return cls.disabled(
+                    f"feed {name!r} shape {var.shape} has dynamic "
+                    "non-batch dims")
+            row_shapes[name] = tuple(var.shape[1:])
+            from paddle_tpu.ops.common import jnp_dtype
+
+            dtypes[name] = jnp_dtype(var.dtype)
+        for name in fetch_names:
+            var = block.find_var(name)
+            if var is None or var.shape is None:
+                return cls.disabled(f"fetch {name!r} has no shape metadata")
+            if var.lod_level:
+                return cls.disabled(f"fetch {name!r} is LoD "
+                                    f"(lod_level={var.lod_level})")
+            if len(var.shape) < 1 or var.shape[0] != -1:
+                return cls.disabled(
+                    f"fetch {name!r} shape {var.shape} is not batch-major "
+                    "(per-request rows cannot be scattered back)")
+        return cls(True, "ok", feed_names, row_shapes, dtypes)
+
+    def classify(self, feeds: Dict[str, np.ndarray]):
+        """``(rows, cast_feeds)`` when this request can join a coalesced
+        batch, else ``None`` (the request executes solo).  Never raises:
+        a shape the spec doesn't recognize is a legacy exact-shape
+        request, not an error."""
+        if not self.batchable or set(feeds) != self._feed_set:
+            return None
+        rows = None
+        cast: Dict[str, np.ndarray] = {}
+        for name in self.feed_names:
+            arr = feeds[name]
+            shape = np.shape(arr)
+            if len(shape) != len(self.row_shapes[name]) + 1 or shape[0] < 1:
+                return None
+            if tuple(shape[1:]) != self.row_shapes[name]:
+                return None
+            if rows is None:
+                rows = shape[0]
+            elif shape[0] != rows:
+                return None
+            if arr.dtype != self.dtypes[name]:
+                arr = arr.astype(self.dtypes[name])
+            cast[name] = arr
+        return rows, cast
+
+
+class PendingRequest:
+    """One in-flight request: feeds + row span + completion event."""
+
+    __slots__ = ("feeds", "rows", "batchable", "deadline", "enqueued_at",
+                 "abandoned", "outputs", "error", "bucket", "_event", "_done")
+
+    def __init__(self, feeds: Dict[str, Any], rows: int = 1,
+                 batchable: bool = False, deadline: Optional[float] = None):
+        self.feeds = feeds
+        self.rows = rows
+        self.batchable = batchable
+        self.deadline = deadline          # time.monotonic timestamp
+        self.enqueued_at = time.monotonic()
+        self.abandoned = False            # waiter gave up (timed out)
+        self.outputs: Optional[list] = None
+        self.error: Optional[BaseException] = None
+        self.bucket: Optional[int] = None  # padded rows it dispatched at
+        self._event = threading.Event()
+        self._done = False
+
+    def complete(self, outputs: list) -> None:
+        if self._done:
+            return
+        self._done = True
+        self.outputs = outputs
+        self._event.set()
+
+    def fail(self, exc: BaseException) -> None:
+        if self._done:
+            return
+        self._done = True
+        self.error = exc
+        self._event.set()
+
+    def wait(self, timeout: Optional[float]) -> bool:
+        return self._event.wait(timeout)
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now > self.deadline
+
+
+class RequestQueue:
+    """Coalescing FIFO the replica pool pulls from.
+
+    ``take()`` (worker side) returns a list of requests forming one
+    dispatch: either a group of batchable requests totalling at most
+    ``max_batch`` rows, or a single unbatchable request.  With
+    ``batch_timeout`` > 0 the head request may linger that long waiting
+    for peers to fill the bucket; at 0 (default) coalescing is purely
+    opportunistic — whatever is queued when a worker frees up rides
+    along, so an idle server adds zero latency.
+    """
+
+    def __init__(self, max_batch: int = 8, batch_timeout: float = 0.0):
+        self.max_batch = max(1, int(max_batch))
+        self.batch_timeout = max(0.0, float(batch_timeout))
+        self._cond = threading.Condition()
+        self._pending: List[PendingRequest] = []
+        self._closed = False
+        self._paused = False
+
+    def pause(self) -> None:
+        """Stop handing out batches (drain/maintenance).  Submissions
+        still queue — and expire against their deadlines."""
+        with self._cond:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._cond:
+            self._paused = False
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._pending)
+
+    def submit(self, req: PendingRequest) -> None:
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("serving queue is shut down")
+            req.enqueued_at = time.monotonic()
+            self._pending.append(req)
+            # notify_all, not notify: a lingering worker (batch_timeout)
+            # also waits on this condition and could swallow the single
+            # wakeup while an idle replica sleeps through it
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            for req in self._pending:
+                req.fail(RuntimeError("server shutting down"))
+            self._pending.clear()
+            self._cond.notify_all()
+
+    # -- worker side --------------------------------------------------------
+
+    def _sweep_locked(self) -> None:
+        """Drop abandoned waiters; expire requests whose deadline passed
+        while queued (they 504 without burning a dispatch)."""
+        now = time.monotonic()
+        live = []
+        for req in self._pending:
+            if req.abandoned:
+                continue
+            if req.expired(now):
+                req.fail(TimeoutError(
+                    "request deadline expired waiting for a serving replica"))
+                continue
+            live.append(req)
+        self._pending = live
+
+    def take(self) -> Optional[List[PendingRequest]]:
+        """Block until a dispatch group is available; None on shutdown."""
+        with self._cond:
+            head = None
+            while head is None:
+                while True:
+                    self._sweep_locked()
+                    if self._closed:
+                        return None
+                    if self._pending and not self._paused:
+                        break
+                    self._cond.wait()
+                head = self._pending[0]
+                if head.batchable and self.batch_timeout > 0:
+                    fill_by = head.enqueued_at + self.batch_timeout
+                    while True:
+                        rows = sum(r.rows for r in self._pending
+                                   if r.batchable)
+                        remaining = fill_by - time.monotonic()
+                        if rows >= self.max_batch or remaining <= 0:
+                            break
+                        self._cond.wait(remaining)
+                        self._sweep_locked()
+                        if self._closed:
+                            return None
+                        if self._paused or not self._pending:
+                            # paused mid-linger (pause() must stop
+                            # dispatch) or everything expired: start over
+                            head = None
+                            break
+                        head = self._pending[0]
+                        if not head.batchable:
+                            break
+            if not head.batchable:
+                batch = [self._pending.pop(0)]
+            else:
+                batch, rows, keep = [], 0, []
+                for req in self._pending:
+                    if req.batchable and (
+                            not batch or rows + req.rows <= self.max_batch):
+                        batch.append(req)
+                        rows += req.rows
+                    else:
+                        keep.append(req)
+                self._pending = keep
+            now = time.monotonic()
+            for req in batch:
+                _M_QUEUE_WAIT.observe(max(0.0, now - req.enqueued_at))
+            return batch
+
+
+def coalesce(batch: Sequence[PendingRequest], spec: BatchSpec):
+    """Stack the batch's rows per feed and pad up to the bucket shape.
+
+    Padding replicates each feed's last real row: the padded rows run
+    through the same program and are discarded by ``scatter``, and a
+    copy of a real row cannot introduce NaN/Inf the way synthetic zeros
+    could (e.g. under normalization).
+    """
+    rows = sum(r.rows for r in batch)
+    bucket = next_bucket(rows)
+    feeds: Dict[str, np.ndarray] = {}
+    for name in spec.feed_names:
+        parts = [np.asarray(r.feeds[name]) for r in batch]
+        if len(parts) == 1 and bucket == rows:
+            feeds[name] = parts[0]
+            continue
+        if bucket > rows:
+            parts.append(np.repeat(parts[-1][-1:], bucket - rows, axis=0))
+        feeds[name] = np.concatenate(parts, axis=0)
+    return feeds, rows, bucket
+
+
+def scatter(batch: Sequence[PendingRequest], outs: Sequence[Any],
+            bucket: int) -> None:
+    """Slice each fetch back to its waiter (de-padding)."""
+    for o in outs:
+        lead = getattr(o, "shape", (None,))[0] if np.ndim(o) else None
+        if lead != bucket:
+            raise RuntimeError(
+                f"fetch output shape {np.shape(o)} is not batch-aligned to "
+                f"the dispatched bucket ({bucket} rows); the program's shape "
+                "metadata mis-declared a batch-major fetch")
+    start = 0
+    for req in batch:
+        req.complete([o[start:start + req.rows] for o in outs])
+        start += req.rows

@@ -26,12 +26,23 @@ echo "== paddle stats: telemetry registry smoke"
 $PADDLE stats --json > /dev/null
 $PADDLE stats > /dev/null
 
-echo "== ruff: analysis + observability + distributed fault-tolerance"
+echo "== ruff: analysis + observability + distributed fault-tolerance + serving"
 if command -v ruff >/dev/null 2>&1; then
     ruff check paddle_tpu/analysis/ paddle_tpu/observability/ \
-        paddle_tpu/distributed/elastic.py paddle_tpu/distributed/retry.py
+        paddle_tpu/distributed/elastic.py paddle_tpu/distributed/retry.py \
+        paddle_tpu/serving/ benchmark/serving_bench.py
 else
     echo "ruff not installed; skipping style pass"
 fi
+
+echo "== serving_bench: smoke (batching engine + artifact writer)"
+python benchmark/serving_bench.py --smoke --out /tmp/serving_bench_smoke.json \
+    > /dev/null
+python - <<'EOF'
+import json
+doc = json.load(open("/tmp/serving_bench_smoke.json"))
+assert doc["schema"] == "paddle_tpu.serving_bench.v1", doc["schema"]
+assert doc["configs"], "no bench configs recorded"
+EOF
 
 echo "lint_self OK"

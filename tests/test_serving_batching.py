@@ -1,0 +1,442 @@
+"""Continuous-batching serving engine (ISSUE 13): batched-vs-sequential
+numerical parity, bucket math, the bucketer's shape-metadata decision,
+and the strict-payload 400.
+
+The load-bearing guarantee: coalescing concurrent requests into one
+padded power-of-two bucket and scattering the de-padded rows back must
+be **bit-identical** to running each request serially at its exact
+shape — row-parallel programs compute each output row independently, so
+padding can change the program shape but never the numerics of real
+rows.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.serving import InferenceServer
+from paddle_tpu.serving.batching import (
+    BatchSpec,
+    RequestQueue,
+    bucket_ladder,
+    next_bucket,
+)
+
+
+def _post(addr, payload, timeout=60):
+    req = urllib.request.Request(
+        f"http://{addr}/predict", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _metrics(addr):
+    with urllib.request.urlopen(f"http://{addr}/metrics", timeout=30) as r:
+        return r.read().decode()
+
+
+def _dense_model(tmp_path, in_dim=4, classes=3):
+    """The bundled inference model (one fc+softmax): row-parallel, so
+    XLA computes each output row with the same instruction sequence at
+    every batch shape — the basis of the bit-parity guarantee.  (Deeper
+    stacks may re-tile intermediate reductions per batch shape and
+    drift in the last ULP; those still pass allclose, not array_equal.)
+    """
+    x = fluid.layers.data(name="x", shape=[in_dim], dtype="float32")
+    pred = fluid.layers.fc(input=x, size=classes, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    d = str(tmp_path / "model")
+    fluid.io.save_inference_model(d, ["x"], [pred], exe)
+    return d, exe, pred
+
+
+# ---------------------------------------------------------------------------
+# Bucket math
+# ---------------------------------------------------------------------------
+
+
+def test_next_bucket_powers_of_two():
+    assert [next_bucket(r) for r in (1, 2, 3, 4, 5, 8, 9, 16, 100)] == \
+        [1, 2, 4, 4, 8, 8, 16, 16, 128]
+    assert bucket_ladder(8) == (1, 2, 4, 8)
+    assert bucket_ladder(6) == (1, 2, 4, 8)   # cap rounds up to a pow2
+    assert bucket_ladder(1) == (1,)
+
+
+def test_queue_coalesces_up_to_max_batch_rows():
+    from paddle_tpu.serving.batching import PendingRequest
+
+    q = RequestQueue(max_batch=4)
+    reqs = [PendingRequest({"x": np.zeros((r, 2))}, rows=r, batchable=True)
+            for r in (2, 1, 1, 3)]
+    for r in reqs:
+        q.submit(r)
+    first = q.take()
+    assert [r.rows for r in first] == [2, 1, 1]   # 4 rows == max_batch
+    second = q.take()
+    assert [r.rows for r in second] == [3]
+    q.close()
+
+
+def test_queue_never_splits_an_oversized_request():
+    from paddle_tpu.serving.batching import PendingRequest
+
+    q = RequestQueue(max_batch=4)
+    q.submit(PendingRequest({"x": np.zeros((9, 2))}, rows=9, batchable=True))
+    (req,) = q.take()
+    assert req.rows == 9          # dispatched alone, padded to bucket 16
+    q.close()
+
+
+# ---------------------------------------------------------------------------
+# Batched-vs-sequential parity (the acceptance bar: bit-identical)
+# ---------------------------------------------------------------------------
+
+
+def test_coalesced_execution_bit_identical_to_serial(tmp_path):
+    """Coalescing is numerically invisible, at two strictnesses:
+
+    1. **Bit-identical per bucket shape** (the engine's guarantee):
+       for every bucket a coalesced dispatch used, running each member
+       request alone — padded to that same bucket — reproduces the
+       batched rows exactly.  Coalescing, padding content, row
+       position, and de-padding scatter contribute zero ULPs.
+    2. **Strict allclose across shapes** (the compiler's bound): the
+       batched outputs match serial exact-shape runs to float32
+       round-off.  XLA CPU re-tiles the gemm per batch shape (visible
+       at the tier-1 suite's --xla_backend_optimization_level=0), so
+       *cross-shape* equality is last-ULP, not bitwise — that slack
+       comes from the compiler, not the batcher, and (1) proves it.
+    """
+    import time
+
+    from paddle_tpu.serving.batching import PendingRequest
+
+    d, exe, pred = _dense_model(tmp_path)
+    rng = np.random.RandomState(7)
+    reqs = [rng.randn(rows, 4).astype("float32")
+            for rows in (1, 2, 3, 1, 5, 1, 2, 4, 1, 1)]
+    serial = [np.asarray(exe.run(feed={"x": r}, fetch_list=[pred])[0])
+              for r in reqs]
+
+    srv = InferenceServer(d, replicas=2, max_batch=8, warmup=True)
+    try:
+        # drive the engine through its own classifier, pool paused so
+        # coalescing is guaranteed (white-box: we need each request's
+        # dispatched bucket for the bitwise oracle)
+        srv.pause()
+        pending = []
+        for r in reqs:
+            rows, cast = srv._spec.classify({"x": r})
+            req = PendingRequest(cast, rows=rows, batchable=True)
+            srv._queue.submit(req)
+            pending.append(req)
+        srv.resume()
+        for req in pending:
+            assert req.wait(60) and req.error is None, req.error
+
+        buckets_seen = set()
+        for i, req in enumerate(pending):
+            got = np.asarray(req.outputs[0])
+            assert got.shape == serial[i].shape
+            # (2) cross-shape: float32 round-off only
+            np.testing.assert_allclose(got, serial[i], rtol=1e-6, atol=0)
+            # (1) same-bucket: bit-identical — pad the request alone to
+            # the bucket its batch dispatched at, run serially, compare
+            b = req.bucket
+            buckets_seen.add(b)
+            pad = np.concatenate(
+                [reqs[i], np.repeat(reqs[i][-1:], b - req.rows, axis=0)])
+            want = np.asarray(
+                exe.run(feed={"x": pad}, fetch_list=[pred])[0])[:req.rows]
+            assert np.array_equal(got, want), (
+                f"request {i}: coalesced rows differ from a serial run "
+                f"padded to the same bucket {b}")
+
+        # the engine really batched: multi-request buckets were used
+        assert any(b > 1 for b in buckets_seen), buckets_seen
+        assert any(req.bucket > req.rows for req in pending)
+    finally:
+        srv.stop()
+
+
+def test_http_concurrent_mixed_sizes_match_serial(tmp_path):
+    """End-to-end over HTTP: concurrent mixed-row-count clients get the
+    same answers as serial in-process runs (strict float32 tolerance,
+    JSON round-trip included)."""
+    d, exe, pred = _dense_model(tmp_path)
+    rng = np.random.RandomState(11)
+    reqs = [rng.randn(rows, 4).astype("float32")
+            for rows in (1, 2, 3, 1, 5, 1, 2, 4, 1, 1)]
+    serial = [np.asarray(exe.run(feed={"x": r}, fetch_list=[pred])[0])
+              for r in reqs]
+
+    srv = InferenceServer(d, replicas=2, max_batch=8, warmup=True)
+    try:
+        results = [None] * len(reqs)
+        errors = []
+
+        def client(i):
+            try:
+                code, body = _post(srv.address, {"x": reqs[i].tolist()})
+                assert code == 200, body
+                results[i] = np.asarray(body["outputs"][0], np.float32)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(reqs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        for i in range(len(reqs)):
+            assert results[i].shape == serial[i].shape
+            np.testing.assert_allclose(results[i], serial[i],
+                                       rtol=1e-6, atol=0)
+    finally:
+        srv.stop()
+
+
+def test_warmup_precompiles_buckets_traffic_all_cache_hits(tmp_path):
+    """After warmup() the bucket ladder is compiled on every replica:
+    live traffic is 100% compile-cache hits (one compile per bucket)."""
+    from paddle_tpu import observability as obs
+
+    d, _, _ = _dense_model(tmp_path)
+    srv = InferenceServer(d, replicas=2, max_batch=4, warmup=True)
+    try:
+        misses = obs.REGISTRY.get("executor_compile_cache_miss_total")
+        fp = srv._bundle.program.fingerprint()[:12]
+        after_warmup = misses.value(program=fp)
+        assert after_warmup == 2 * len(bucket_ladder(4))  # replicas x ladder
+
+        rng = np.random.RandomState(0)
+        threads = [
+            threading.Thread(target=lambda r=r: srv.predict(
+                {"x": rng.randn(r, 4).astype("float32").tolist()}))
+            for r in (1, 2, 3, 4, 1, 2)
+        ]
+        srv.pause()
+        for t in threads:
+            t.start()
+        srv.resume()
+        for t in threads:
+            t.join(timeout=60)
+        assert misses.value(program=fp) == after_warmup  # hit rate 1.0
+    finally:
+        srv.stop()
+
+
+def test_lod_fetch_falls_back_solo_and_stays_bit_identical(tmp_path):
+    """A program whose fetch is LoD (lod_level=1) is unbatchable — the
+    bucketer says so from var metadata — and concurrent requests still
+    serve bit-identically through the solo path, LoD tables intact."""
+    x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+    block = fluid.default_main_program().global_block()
+    out = block.create_var(name="lod_out", shape=[-1, 3], dtype="float32",
+                           lod_level=1)
+    block.append_op(type="lod_reset", inputs={"X": [x.name]},
+                    outputs={"Out": [out.name]},
+                    attrs={"target_lod": [0, 1, 2]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    d = str(tmp_path / "lod_model")
+    fluid.io.save_inference_model(d, ["x"], [out], exe)
+
+    rng = np.random.RandomState(3)
+    reqs = [rng.randn(2, 3).astype("float32") for _ in range(6)]
+    serial = [exe.run(feed={"x": r}, fetch_list=[out])[0] for r in reqs]
+
+    srv = InferenceServer(d, replicas=2, max_batch=8)
+    try:
+        assert not srv._spec.batchable
+        assert "lod_out" in srv._spec.reason
+        results = [None] * len(reqs)
+
+        def client(i):
+            code, body = _post(srv.address, {"x": reqs[i].tolist()})
+            assert code == 200, body
+            results[i] = body["outputs"][0]
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(reqs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+
+        for i, got in enumerate(results):
+            want = serial[i]
+            assert np.array_equal(np.asarray(got["data"], np.float32),
+                                  np.asarray(want.data))
+            assert [np.asarray(l).tolist() for l in want.lod] == got["lod"]
+    finally:
+        srv.stop()
+
+
+def test_ragged_sequence_model_unbatchable_but_serves(tmp_path):
+    """@len-style sequence models (dynamic non-batch dims) are
+    unbatchable; requests run solo at their exact shapes, matching
+    in-process inference bitwise."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    vocab, E = 20, 8
+    ids = fluid.layers.data(name="word", shape=[-1, -1, 1], dtype="int64",
+                            append_batch_size=False)
+    lens = fluid.layers.data(name="word@len", shape=[1], dtype="int64")
+    emb = fluid.layers.embedding(ids, size=[vocab, E])
+    helper = LayerHelper("padded_sequence_pool")
+    pooled = helper.create_tmp_variable("float32", (-1, E))
+    helper.append_op(type="padded_sequence_pool",
+                     inputs={"X": [emb], "Length": [lens]},
+                     outputs={"Out": [pooled]},
+                     attrs={"pooltype": "MAX"})
+    pred = fluid.layers.fc(input=pooled, size=2, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    d = str(tmp_path / "seq")
+    fluid.io.save_inference_model(d, ["word", "word@len"], [pred], exe)
+
+    xs = np.array([[3, 7, 11, 0, 0], [2, 9, 4, 6, 1]], np.int64)
+    ls = np.array([3, 5], np.int64)
+    (expected,) = exe.run(feed={"word": xs, "word@len": ls},
+                          fetch_list=[pred])
+
+    srv = InferenceServer(d, replicas=2, max_batch=8)
+    try:
+        assert not srv._spec.batchable
+        code, body = _post(srv.address, {"word": xs.tolist(),
+                                         "word@len": ls.tolist()})
+        assert code == 200
+        assert np.array_equal(np.asarray(body["outputs"][0], np.float32),
+                              np.asarray(expected))
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# The bucketer's decision comes from verifier shape metadata
+# ---------------------------------------------------------------------------
+
+
+def test_bucketer_uses_infer_shape_backfill():
+    """A program built from raw ops with shape-less tmp vars becomes
+    batchable because the registry's infer_shape rules (elementwise/
+    matmul families — the ISSUE 13 ratchet) backfill the fetch shape."""
+    prog = fluid.framework.Program()
+    block = prog.global_block()
+    block.create_var(name="x", shape=[-1, 4], dtype="float32")
+    block.create_var(name="w", shape=[4, 2], dtype="float32",
+                     persistable=True)
+    block.create_var(name="b", shape=[2], dtype="float32", persistable=True)
+    block.create_var(name="xw", shape=None, dtype="float32")
+    block.create_var(name="out", shape=None, dtype="float32")
+    block.append_op(type="mul", inputs={"X": ["x"], "Y": ["w"]},
+                    outputs={"Out": ["xw"]})
+    block.append_op(type="elementwise_add",
+                    inputs={"X": ["xw"], "Y": ["b"]},
+                    outputs={"Out": ["out"]})
+    spec = BatchSpec.from_program(prog, ["x"], ["out"])
+    assert spec.batchable, spec.reason
+    assert block.find_var("out").shape == (-1, 2)   # backfilled
+
+
+def test_bucketer_rejects_reduced_fetch():
+    """A fetch that reduces over the batch (mean) must never be
+    bucketed — de-padding cannot undo a cross-row reduction.  The
+    reduce-family infer_shape rule fills the scalar shape that proves
+    it."""
+    prog = fluid.framework.Program()
+    block = prog.global_block()
+    block.create_var(name="x", shape=[-1, 4], dtype="float32")
+    block.create_var(name="m", shape=None, dtype="float32")
+    block.append_op(type="mean", inputs={"X": ["x"]},
+                    outputs={"Out": ["m"]})
+    spec = BatchSpec.from_program(prog, ["x"], ["m"])
+    assert not spec.batchable
+    assert "m" in spec.reason and "batch-major" in spec.reason
+    assert block.find_var("m").shape == ()          # backfilled scalar
+
+
+def test_infer_shape_validates_matmul_extents():
+    """The new matmul/mul rules reject statically-impossible
+    contractions — at append time for built programs (the reference's
+    compile-time InferShape), and as PVE07 through the verifier for
+    programs loaded from disk (which skip append-time checks)."""
+    from paddle_tpu import analysis
+
+    def build(prog):
+        block = prog.global_block()
+        block.create_var(name="a", shape=[2, 3], dtype="float32")
+        block.create_var(name="bad", shape=[4, 5], dtype="float32")
+        block.create_var(name="o", shape=None, dtype="float32")
+        block.append_op(type="matmul", inputs={"X": ["a"], "Y": ["bad"]},
+                        outputs={"Out": ["o"]})
+
+    with pytest.raises(ValueError, match="inner extents differ"):
+        build(fluid.framework.Program())
+
+    # loaded programs bypass append-time InferShape (Operator.__new__):
+    # rebuild the same broken program through the wire format and let
+    # the verifier surface it
+    prog = fluid.framework.Program()
+    block = prog.global_block()
+    block.create_var(name="a", shape=[2, 3], dtype="float32")
+    block.create_var(name="bad", shape=[4, 5], dtype="float32")
+    block.create_var(name="o", shape=None, dtype="float32")
+    d = prog.to_dict()
+    d["blocks"][0]["ops"].append({
+        "type": "matmul", "inputs": {"X": ["a"], "Y": ["bad"]},
+        "outputs": {"Out": ["o"]}, "attrs": {}})
+    loaded = fluid.framework.Program.from_dict(d)
+    diags = analysis.verify_program(loaded, feed_names={"a", "bad"},
+                                    fetch_names=["o"])
+    assert any(d.code == "PVE07" for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# Strict payload keys (satellite): no silent drops into someone's bucket
+# ---------------------------------------------------------------------------
+
+
+def test_unknown_payload_key_is_400_naming_the_key(tmp_path):
+    d, _, _ = _dense_model(tmp_path)
+    srv = InferenceServer(d)
+    try:
+        code, body = _post(srv.address,
+                           {"x": [[0.0] * 4], "typo_feed": [[1.0]]})
+        assert code == 400
+        assert "typo_feed" in body["error"]
+        # @len side-feeds still ride along without tripping the check
+        code, _ = _post(srv.address, {"x": [[0.0] * 4], "x@len": [4]})
+        assert code == 200
+    finally:
+        srv.stop()
+
+
+def test_health_reports_batching_decision(tmp_path):
+    d, _, _ = _dense_model(tmp_path)
+    srv = InferenceServer(d, replicas=3, max_batch=16)
+    try:
+        with urllib.request.urlopen(f"http://{srv.address}/health",
+                                    timeout=30) as r:
+            h = json.loads(r.read())
+        assert h["batching"] == {
+            "enabled": True, "reason": "ok", "replicas": 3,
+            "max_batch": 16, "batch_timeout_ms": 0.0,
+            "buckets": [1, 2, 4, 8, 16],
+        }
+    finally:
+        srv.stop()

@@ -1,9 +1,13 @@
 """Serving graceful-degradation tests: per-request deadline (504) and
-bounded in-flight admission (503) instead of unbounded thread pileup
-behind the executor lock (ISSUE 12 satellite; counters on /metrics)."""
+bounded in-flight admission (503) instead of unbounded request pileup
+behind the replica pool (ISSUE 12 satellite, re-based onto the
+continuous-batching engine in ISSUE 13; counters on /metrics).  The old
+tests stalled `srv._lock` — the lock is gone, so these stall the pool
+via its drain hook (`pause`/`resume`)."""
 
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -55,17 +59,15 @@ def test_deadline_expiry_returns_504_and_counts(model_dir):
     try:
         # warm the compile cache so the stall below is the only delay
         assert _post(srv.address, {"x": [[0.0] * 4]})[0] == 200
-        # stall the executor: the request expires in the queue
-        srv._lock.acquire()
-        try:
-            code, body = _post(srv.address, {"x": [[1.0] * 4]})
-        finally:
-            srv._lock.release()
+        # stall every replica: the request expires in the batching queue
+        srv.pause()
+        code, body = _post(srv.address, {"x": [[1.0] * 4]})
+        srv.resume()
         assert code == 504
         assert "deadline" in body["error"]
         metrics = _get(srv.address, "/metrics")
         assert 'serving_rejected_total{reason="deadline"} 1' in metrics
-        # service recovers once the executor frees up
+        # service recovers once the replicas resume
         assert _post(srv.address, {"x": [[1.0] * 4]})[0] == 200
     finally:
         srv.stop()
@@ -75,7 +77,7 @@ def test_overload_returns_503_and_counts(model_dir):
     srv = InferenceServer(model_dir, request_timeout=5.0, max_inflight=1)
     try:
         assert _post(srv.address, {"x": [[0.0] * 4]})[0] == 200
-        srv._lock.acquire()   # hold the executor so one request queues
+        srv.pause()   # stall the pool so one admitted request queues
         results = {}
 
         def occupant():
@@ -84,10 +86,7 @@ def test_overload_returns_503_and_counts(model_dir):
         t = threading.Thread(target=occupant)
         t.start()
         # wait until the occupant holds the single in-flight slot
-        deadline = 50
-        import time
-
-        for _ in range(deadline * 10):
+        for _ in range(500):
             if srv._slots._value == 0:  # noqa: SLF001 - observing the cap
                 break
             time.sleep(0.1)
@@ -95,17 +94,13 @@ def test_overload_returns_503_and_counts(model_dir):
         code, body = _post(srv.address, {"x": [[2.0] * 4]})
         assert code == 503
         assert "overloaded" in body["error"]
-        srv._lock.release()
+        srv.resume()
         t.join(timeout=30)
         assert results["first"][0] == 200   # queued request completed
         metrics = _get(srv.address, "/metrics")
         assert 'serving_rejected_total{reason="overload"} 1' in metrics
     finally:
-        if srv._lock.locked():
-            try:
-                srv._lock.release()
-            except RuntimeError:
-                pass
+        srv.resume()
         srv.stop()
 
 
@@ -114,5 +109,45 @@ def test_bounds_off_by_default(model_dir):
     try:
         assert srv._request_timeout is None and srv._slots is None
         assert _post(srv.address, {"x": [[1.0] * 4]})[0] == 200
+    finally:
+        srv.stop()
+
+
+def test_client_disconnect_counts_not_crashes(model_dir):
+    """A client that hangs up before reading the response body is
+    counted as serving_rejected_total{reason="client_gone"}; the
+    server keeps serving."""
+    import socket
+
+    srv = InferenceServer(model_dir)
+    try:
+        assert _post(srv.address, {"x": [[0.0] * 4]})[0] == 200  # warm
+        host, port = srv.address.split(":")
+        body = json.dumps({"x": [[1.0] * 4]}).encode()
+        for _ in range(3):
+            s = socket.create_connection((host, int(port)), timeout=10)
+            s.sendall(b"POST /predict HTTP/1.1\r\n"
+                      b"Host: x\r\nContent-Type: application/json\r\n"
+                      + f"Content-Length: {len(body)}\r\n\r\n".encode()
+                      + body)
+            # slam the door without reading the response
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                         b"\x01\x00\x00\x00\x00\x00\x00\x00")
+            s.close()
+        # the server must still answer, and must have counted (not
+        # crashed on) at least one mid-response disconnect
+        deadline = time.monotonic() + 30
+        gone = 0
+        while time.monotonic() < deadline:
+            assert _post(srv.address, {"x": [[2.0] * 4]})[0] == 200
+            metrics = _get(srv.address, "/metrics")
+            hits = [l for l in metrics.splitlines()
+                    if l.startswith("serving_rejected_total")
+                    and 'reason="client_gone"' in l]
+            if hits:
+                gone = float(hits[0].rsplit(" ", 1)[1])
+                break
+            time.sleep(0.1)
+        assert gone >= 1
     finally:
         srv.stop()

@@ -13,6 +13,21 @@ import pytest
 import paddle_tpu as fluid
 
 
+@pytest.fixture(autouse=True)
+def _no_persistent_xla_cache():
+    """The persistent XLA compile cache (conftest) segfaults this host's
+    jaxlib when it *deserializes* the sparse-program executables this
+    module compiles (write succeeds, second run crashes inside the cache
+    readback — reproducible on unmodified trees, and it killed whole
+    tier-1 windows at ~85%).  Keep the cache for every other suite;
+    skip it for exactly these programs."""
+    import jax
+
+    jax.config.update("jax_enable_compilation_cache", False)
+    yield
+    jax.config.update("jax_enable_compilation_cache", True)
+
+
 def _embedding_step(rng, is_sparse, optimizer, ids, vocab=60, dim=8, steps=1):
     """Build embedding -> fc -> softmax CE, run `steps` batches, return
     the embedding table."""
