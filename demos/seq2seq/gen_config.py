@@ -1,0 +1,69 @@
+"""Generation-serving config for the NMT demo (reference: the
+demo/seqToseq gen.conf half of the train/gen config pair).
+
+``paddle serve --gen_config=demos/seq2seq/gen_config.py`` exec's this
+file and calls ``make_generator()`` for the ``(beam_gen, parameters)``
+pair behind ``POST /generate``.  Parameters come from a trained
+Parameters tar when ``PADDLE_GEN_PARAMS`` names one (written with
+``parameters.to_tar``); otherwise the demo trains a few quick passes
+in-process first — fine for the 16-token toy vocabulary, stand-in for
+loading a real checkpoint.
+"""
+
+import os
+
+
+def make_beam_gen(beam_size: int = 4, max_length: int = 9):
+    """The demo's generation spec — the single builder the serving
+    config, the decode benchmark, and the parity tests all share, so
+    the oracle relationship can never drift between copies."""
+    import paddle_tpu.v2 as paddle
+    from paddle_tpu.trainer_config_helpers import (GeneratedInput,
+                                                   StaticInput,
+                                                   beam_search, data_layer)
+
+    from demos.seq2seq.network import (BOS, EMB, EOS, HID, VOCAB,
+                                       decoder_step, encoder)
+
+    src = data_layer(name="src", size=VOCAB)
+    src.input_type = paddle.data_type.integer_value_sequence(VOCAB)
+    enc = encoder(src)
+    return beam_search(
+        step=decoder_step,
+        input=[GeneratedInput(size=VOCAB, embedding_name="trg_emb",
+                              embedding_size=EMB),
+               StaticInput(enc, is_seq=True, size=HID)],
+        bos_id=BOS, eos_id=EOS, beam_size=beam_size,
+        max_length=max_length)
+
+
+def make_generator():
+    beam_gen = make_beam_gen(
+        max_length=int(os.environ.get("PADDLE_GEN_MAXLEN", "9")))
+
+    params_tar = os.environ.get("PADDLE_GEN_PARAMS")
+    if params_tar:
+        from paddle_tpu.executor import Scope
+
+        class _Params:
+            scope = Scope()
+
+        parameters = _Params()
+        import io as _io
+        import tarfile
+        import numpy as np
+
+        with tarfile.open(params_tar) as tar:
+            for m in tar.getmembers():
+                name = m.name[:-4] if m.name.endswith(".npy") else m.name
+                parameters.scope.set(name, np.load(
+                    _io.BytesIO(tar.extractfile(m).read()),
+                    allow_pickle=False))
+    else:
+        from paddle_tpu.trainer import train_from_config
+
+        passes = int(os.environ.get("PADDLE_GEN_TRAIN_PASSES", "8"))
+        tc, _ = train_from_config("demos/seq2seq/trainer_config.py",
+                                  num_passes=passes, log_period=10 ** 9)
+        parameters = tc.parameters
+    return beam_gen, parameters

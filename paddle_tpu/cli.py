@@ -11,14 +11,21 @@ Subcommands:
        binary for the C API; here: re-parse the v1 config, load the
        pass params, export a save_inference_model directory that
        capi/paddle_tpu_capi.h consumes)
-  paddle serve --model_dir=DIR [--port=N] [--replicas=N] [--max_batch=N]
+  paddle serve [--model_dir=DIR] [--port=N] [--replicas=N] [--max_batch=N]
                [--batch_timeout_ms=MS] [--warmup]
                [--request_timeout=SECONDS] [--max_inflight=N]
+               [--gen_config=SCRIPT] [--gen_pages=N] [--gen_page_size=N]
+               [--gen_pages_per_seq=N] [--gen_slots=N] [--gen_queue=N]
+               [--gen_max_tokens=N]
       (HTTP JSON inference over a save_inference_model export —
        paddle_tpu/serving: bucketed request coalescing into power-of-two
        batch shapes + a pool of executor replicas; --warmup pre-compiles
        the bucket ladder; --request_timeout returns 504 on expiry,
-       --max_inflight sheds load with 503 instead of piling up threads)
+       --max_inflight sheds load with 503 instead of piling up threads.
+       --gen_config mounts POST /generate: token streaming over the
+       paged-KV continuous-batching decode engine, paddle_tpu/decode —
+       the script defines make_generator() -> (beam_gen, parameters),
+       see demos/seq2seq/gen_config.py)
   paddle elastic --coord=HOST:PORT --checkpoint-dir=DIR [--job=NAME]
                  [--tasks=N] [--passes=P] [--worker-id=ID] ...
       (preemption-safe demo training worker —
@@ -131,26 +138,61 @@ def _serve(make_server, argv, label):
     return 0
 
 
+def _load_generator(args):
+    """Build a paged-KV GenerationEngine from a --gen_config script.
+
+    The script is exec'd and must define ``make_generator()`` returning
+    ``(beam_gen, parameters)`` — a v1 ``beam_search`` spec plus trained
+    parameters (see demos/seq2seq/gen_config.py).  Page-pool geometry
+    comes from the --gen_* flags."""
+    _cwd_importable()
+    from paddle_tpu.decode import GenerationEngine
+
+    path = args["gen_config"]
+    glb = {"__file__": path, "__name__": "__paddle_serve_gen__"}
+    with open(path) as f:
+        exec(compile(f.read(), path, "exec"), glb)
+    if "make_generator" not in glb:
+        raise RuntimeError(
+            f"{path} defines no make_generator() -> (beam_gen, parameters)")
+    beam_gen, parameters = glb["make_generator"]()
+    return GenerationEngine.for_seq2seq(
+        beam_gen, parameters,
+        num_pages=int(args.get("gen_pages", 64)),
+        page_size=int(args.get("gen_page_size", 8)),
+        pages_per_seq=int(args.get("gen_pages_per_seq", 2)),
+        max_slots=int(args.get("gen_slots", 8)),
+        max_waiting=int(args.get("gen_queue", 64)),
+        max_new_tokens=(int(args["gen_max_tokens"])
+                        if args.get("gen_max_tokens") else None))
+
+
 def cmd_serve(argv):
-    """paddle serve --model_dir=DIR [--port=N] [--replicas=N]
+    """paddle serve [--model_dir=DIR] [--port=N] [--replicas=N]
     [--max_batch=N] [--batch_timeout_ms=MS] [--warmup]
-    [--request_timeout=S] [--max_inflight=N] — HTTP inference over a
-    save_inference_model export (paddle_tpu/serving): concurrent
-    requests coalesce into power-of-two batch buckets dispatched across
-    a pool of executor replicas, with graceful-degradation bounds (504
-    on deadline expiry, 503 on overload)."""
+    [--request_timeout=S] [--max_inflight=N]
+    [--gen_config=SCRIPT --gen_pages=N --gen_page_size=N
+     --gen_pages_per_seq=N --gen_slots=N --gen_queue=N
+     --gen_max_tokens=N] — HTTP inference over a save_inference_model
+    export (paddle_tpu/serving): concurrent requests coalesce into
+    power-of-two batch buckets dispatched across a pool of executor
+    replicas, with graceful-degradation bounds (504 on deadline expiry,
+    503 on overload).  With --gen_config, also mounts POST /generate —
+    token streaming over the paged-KV continuous-batching decode
+    engine (paddle_tpu/decode)."""
     from paddle_tpu.serving import InferenceServer
 
     args, rest = _kv_args(argv)
-    if not args.get("model_dir"):
-        print("usage: paddle serve --model_dir=DIR [--port=N] "
+    if not args.get("model_dir") and not args.get("gen_config"):
+        print("usage: paddle serve [--model_dir=DIR] [--port=N] "
               "[--replicas=N] [--max_batch=N] [--batch_timeout_ms=MS] "
-              "[--warmup] [--request_timeout=SECONDS] [--max_inflight=N]",
-              file=sys.stderr)
+              "[--warmup] [--request_timeout=SECONDS] [--max_inflight=N] "
+              "[--gen_config=SCRIPT ...] (need --model_dir and/or "
+              "--gen_config)", file=sys.stderr)
         return 2
     return _serve(
         lambda a: InferenceServer(
-            a["model_dir"], port=int(a.get("port", 0)),
+            a.get("model_dir"), port=int(a.get("port", 0)),
             request_timeout=(float(a["request_timeout"])
                              if a.get("request_timeout") else None),
             max_inflight=(int(a["max_inflight"])
@@ -158,7 +200,9 @@ def cmd_serve(argv):
             replicas=int(a.get("replicas", 1)),
             max_batch=int(a.get("max_batch", 8)),
             batch_timeout_ms=float(a.get("batch_timeout_ms", 0.0)),
-            warmup="--warmup" in rest),
+            warmup="--warmup" in rest,
+            generator=(_load_generator(a) if a.get("gen_config")
+                       else None)),
         argv, "inference server")
 
 

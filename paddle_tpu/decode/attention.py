@@ -1,0 +1,224 @@
+"""Ragged paged-attention decode kernel + dense prefill path.
+
+Decode shape (the "Ragged Paged Attention" design, PAPERS.md): each
+active sequence contributes ONE query token per step, but its context
+lives scattered across fixed-size KV pages named by a per-sequence page
+table.  The kernel runs a ``(slots, pages_per_seq)`` grid with the page
+table and lengths *scalar-prefetched* into SMEM, so each K/V BlockSpec
+picks its page straight from the table — the gather never materializes
+a per-sequence contiguous copy — and pages wholly past the sequence
+length are skipped (their FLOPs AND their DMA do not happen, same trick
+as the causal-block skip in ``pallas/flash_attention.py``).  Softmax is
+the same online (running max / normalizer) accumulation as the flash
+forward, in f32 VMEM scratch.
+
+Prefill stays dense: a prompt is contiguous, so the existing flash
+attention forward (``pallas/flash_attention.py``) — or its jnp fallback
+at small shapes — handles it, and the resulting K/V rows are written
+into pages once.
+
+Everything runs under ``interpret=True`` on CPU for numerics tests; the
+jnp reference (``ragged_paged_attention_reference``) is both the test
+oracle and the dispatch fallback off-TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from paddle_tpu.pallas import compat as _compat
+
+_F32 = jnp.float32
+_NEG_INF = -1e30  # matches flash_attention: finite, avoids inf-inf NaN
+
+
+def fits(page_size: int, num_heads: int, head_dim: int) -> bool:
+    """Shapes the kernel's block layout supports."""
+    return (page_size % 8 == 0 and head_dim % 8 == 0
+            and head_dim <= 256 and num_heads >= 1)
+
+
+# ---------------------------------------------------------------------------
+# reference (jnp): the oracle + off-TPU fallback
+# ---------------------------------------------------------------------------
+
+
+def ragged_paged_attention_reference(q, k_pages, v_pages, page_tables,
+                                     lens, scale=None):
+    """q (S, H, D); k/v_pages (N, page, H, D); page_tables (S, P) int;
+    lens (S,) valid KV rows per slot -> out (S, H, D).
+
+    Pure jnp, fixed shape: the gather is a fancy-index over the pool,
+    the mask zeroes positions at or past each slot's length.
+    """
+    S, H, D = q.shape
+    page = k_pages.shape[1]
+    P = page_tables.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+    k = k_pages[page_tables].reshape(S, P * page, H, D).astype(_F32)
+    v = v_pages[page_tables].reshape(S, P * page, H, D).astype(_F32)
+    s = jnp.einsum("shd,sthd->sht", q.astype(_F32), k) * scale
+    t = jnp.arange(P * page)
+    mask = t[None, :] < lens.reshape(-1, 1)
+    s = jnp.where(mask[:, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("sht,sthd->shd", p, v)
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+
+def _rpa_kernel(ptab_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                m_scr, l_scr, acc_scr, *, scale, page, npp):
+    """One (slot, page) grid step: accumulate this page's contribution
+    to the slot's online softmax."""
+    s = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # pages wholly past the length contribute nothing: skip their math
+    # (the BlockSpec still names a page — the null page for table
+    # padding — but the guarded body never reads it)
+    seq_len = lens_ref[s]
+
+    @pl.when(p * page < seq_len)
+    def _page():
+        q = q_ref[0].astype(_F32)                       # (H, D)
+        k = k_ref[0].astype(_F32)                       # (page, H, D)
+        v = v_ref[0].astype(_F32)
+        # scores (H, page): per-head q . k_t, contracted over D
+        sc = jax.lax.dot_general(
+            q, k, (((1,), (2,)), ((0,), (1,))),
+            preferred_element_type=_F32) * scale
+        t_pos = p * page + jax.lax.broadcasted_iota(
+            jnp.int32, sc.shape, 1)
+        sc = jnp.where(t_pos < seq_len, sc, _NEG_INF)
+        m_prev = m_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
+        pr = jnp.exp(sc - m_new)                        # (H, page)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:, 0:1] = l_scr[:, 0:1] * corr + jnp.sum(pr, axis=1,
+                                                       keepdims=True)
+        m_scr[:, 0:1] = m_new
+        # (H, page) x (page, H, D) batched over H -> (H, D)
+        acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
+            pr, v, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=_F32)
+
+    @pl.when(p == npp - 1)
+    def _finish():
+        l = l_scr[:, 0:1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def ragged_paged_attention(q, k_pages, v_pages, page_tables, lens,
+                           scale=None, interpret: bool = False):
+    """Pallas ragged paged-attention decode step.
+
+    Same contract as the reference: q (S, H, D), pools (N, page, H, D),
+    page_tables (S, P), lens (S,) -> (S, H, D).
+    """
+    S, H, D = q.shape
+    page = k_pages.shape[1]
+    P = page_tables.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+    ptab = page_tables.astype(jnp.int32)
+    lens32 = lens.astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,            # page table + lens land in SMEM
+        grid=(S, P),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda s, p, pt, ln: (s, 0, 0)),
+            # the K/V block IS the page the table names: the pool is
+            # indexed through the prefetched table, never gathered
+            pl.BlockSpec((1, page, H, D),
+                         lambda s, p, pt, ln: (pt[s, p], 0, 0, 0)),
+            pl.BlockSpec((1, page, H, D),
+                         lambda s, p, pt, ln: (pt[s, p], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda s, p, pt, ln: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 1), _F32),     # running max
+            pltpu.VMEM((H, 1), _F32),     # running normalizer
+            pltpu.VMEM((H, D), _F32),     # output accumulator
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_rpa_kernel, scale=scale, page=page, npp=P),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, D), q.dtype),
+        compiler_params=_compat.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(ptab, lens32, q, k_pages, v_pages)
+
+
+def paged_attention(q, k_pages, v_pages, page_tables, lens, scale=None):
+    """Dispatcher: the Pallas kernel when the pallas mode allows it
+    (forced on, or auto on a TPU backend at supported shapes), else the
+    jnp reference — both jit-embeddable, identical contract."""
+    from paddle_tpu import pallas as pk
+
+    S, H, D = q.shape
+    mode = pk.mode()
+    if mode != "off" and fits(k_pages.shape[1], H, D):
+        if mode == "on":
+            return ragged_paged_attention(
+                q, k_pages, v_pages, page_tables, lens, scale=scale,
+                interpret=pk.interpret_mode())
+        if pk._tpu_backend():
+            return ragged_paged_attention(
+                q, k_pages, v_pages, page_tables, lens, scale=scale)
+    return ragged_paged_attention_reference(
+        q, k_pages, v_pages, page_tables, lens, scale=scale)
+
+
+# ---------------------------------------------------------------------------
+# dense prefill
+# ---------------------------------------------------------------------------
+
+
+def dense_prefill_attention(q, k, v, causal: bool = True):
+    """Prompt-time attention for ONE contiguous sequence: q/k/v
+    (T, H, D) -> (T, H, D).  Reuses the flash-attention forward when its
+    block layout fits the shape (the separately-compiled dense-prefill
+    program of the prefill/decode split); otherwise the plain jnp
+    softmax path — prompts are short where flash does not fit."""
+    from paddle_tpu import pallas as pk
+    from paddle_tpu.pallas import flash_attention as fa
+
+    T, H, D = q.shape
+    qb = jnp.moveaxis(q, 1, 0)            # (H, T, D) = (BH, S, D)
+    kb = jnp.moveaxis(k, 1, 0)
+    vb = jnp.moveaxis(v, 1, 0)
+    if pk.mode() != "off" and fa.fits(1, H, T, D) and (
+            pk.mode() == "on" or pk._tpu_backend()):
+        out = fa.flash_attention(qb, kb, vb, causal=causal,
+                                 interpret=pk.interpret_mode())
+    else:
+        s = jnp.einsum("htd,hsd->hts", qb.astype(_F32),
+                       kb.astype(_F32)) * (D ** -0.5)
+        if causal:
+            t = jnp.arange(T)
+            s = jnp.where(t[:, None] >= t[None, :], s, _NEG_INF)
+        out = jnp.einsum("hts,hsd->htd", jax.nn.softmax(s, axis=-1),
+                         vb.astype(_F32)).astype(q.dtype)
+    return jnp.moveaxis(out, 0, 1)

@@ -1,0 +1,120 @@
+"""GenerationEngine: the serving front over a DecodeSession.
+
+One background stepper thread drives the session's admit->decode->evict
+tick whenever work exists; HTTP handler threads submit requests and
+stream tokens through per-request callbacks.  Admission refusals
+(``AdmissionRefused``: pool can never fit the request, or the wait
+queue is full) surface to the caller — serving maps them to 503, and a
+request deadline to 504, through the same shedding conventions as
+``/predict``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+from paddle_tpu.decode.session import (
+    AdmissionRefused,
+    DecodeRequest,
+    DecodeSession,
+)
+
+__all__ = ["AdmissionRefused", "GenerationEngine"]
+
+
+class GenerationEngine:
+    def __init__(self, model, max_slots: int = 8,
+                 max_waiting: Optional[int] = 64,
+                 max_new_tokens: int = 32,
+                 prompt_of: Optional[Callable] = None):
+        self.model = model
+        self.session = DecodeSession(model, max_slots=max_slots,
+                                     max_waiting=max_waiting)
+        self.max_new_tokens_cap = int(max_new_tokens)
+        # identity by default: most models (TinyDecoderLM) take the id
+        # list as-is; for_seq2seq overrides with the v2 reader-row wrap
+        self._prompt_of = prompt_of or (lambda ids: ids)
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._stepper, daemon=True,
+                                        name="decode-stepper")
+        self._thread.start()
+
+    @classmethod
+    def for_seq2seq(cls, beam_gen, parameters, *, num_pages: int = 64,
+                    page_size: int = 8, pages_per_seq: int = 2,
+                    max_slots: int = 8, max_waiting: Optional[int] = 64,
+                    max_new_tokens: Optional[int] = None,
+                    place=None) -> "GenerationEngine":
+        from paddle_tpu.decode.seq2seq import PagedSeq2SeqModel
+
+        model = PagedSeq2SeqModel(beam_gen, parameters,
+                                  num_pages=num_pages, page_size=page_size,
+                                  pages_per_seq=pages_per_seq, place=place)
+        return cls(model, max_slots=max_slots, max_waiting=max_waiting,
+                   max_new_tokens=(max_new_tokens
+                                   if max_new_tokens is not None
+                                   else beam_gen.max_length),
+                   prompt_of=lambda ids: [ids])
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, src_ids: List[int],
+               max_new_tokens: Optional[int] = None,
+               on_token: Optional[Callable[[int], None]] = None,
+               deadline: Optional[float] = None) -> DecodeRequest:
+        """Queue a generation request.  Raises AdmissionRefused when the
+        engine cannot take it (503-shaped), otherwise returns the
+        request handle — ``wait()``/``result()`` or stream via
+        ``on_token``."""
+        budget = self.max_new_tokens_cap
+        if max_new_tokens is not None:
+            budget = max(1, min(int(max_new_tokens), budget))
+        req = DecodeRequest(self._prompt_of(list(src_ids)),
+                            max_new_tokens=budget, on_token=on_token,
+                            deadline=deadline)
+        self.session.submit(req)
+        self._wake.set()
+        return req
+
+    # -- introspection ------------------------------------------------------
+
+    def info(self) -> dict:
+        alloc = self.model.allocator
+        return {
+            "slots": self.session.max_slots,
+            "active": self.session.active,
+            "waiting": self.session.waiting,
+            "page_size": self.model.page_size,
+            "pages_total": alloc.num_pages - 1,   # page 0 reserved
+            "pages_free": alloc.free_pages,
+            "max_new_tokens": self.max_new_tokens_cap,
+            "bos_id": self.model.bos_id,
+            "eos_id": self.model.eos_id,
+        }
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _stepper(self) -> None:
+        while not self._stop.is_set():
+            if self.session.idle():
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            try:
+                self.session.step()
+            except BaseException as exc:  # poison step: fail waiters, live on
+                self.session.fail_all(exc)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=30)
+        if self._thread.is_alive():
+            # stepper still inside a (likely compiling) step: failing
+            # the slots now would race its evictions (double page
+            # frees).  Leave the daemon thread to drain; waiters keep
+            # their deadlines.
+            return
+        self.session.fail_all(RuntimeError("generation engine stopped"))

@@ -1,0 +1,196 @@
+"""TinyDecoderLM: a pure-JAX decoder-only transformer over paged KV.
+
+The self-attention consumer of the ragged paged-attention kernel (the
+seq2seq adapter pages a *static* cross-attention context; this model
+exercises the growing-KV case): prefill runs the dense causal forward
+(``dense_prefill_attention`` — the flash-attention path when the shape
+fits) and pages the prompt's K/V once; every decode step appends one
+K/V row per sequence into its pages and attends over its page table.
+The decode step is ONE jitted fixed-shape function of
+``(pools, page_tables, lens, tokens)`` — batch composition churn never
+re-traces.
+
+Weights are randomly initialized from a seed: this model exists to
+prove the kernel + session mechanics (tests pin the paged decode
+against a dense incremental oracle) and to feed the decode benchmark,
+not to be a trained LM.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.decode.attention import (
+    dense_prefill_attention,
+    paged_attention,
+)
+from paddle_tpu.decode.paged_kv import PageAllocator
+
+_F32 = jnp.float32
+
+
+def _init_params(key, vocab, d, heads, layers, max_len):
+    ks = jax.random.split(key, 2 + layers)
+    s = 0.02
+    params = {
+        "emb": jax.random.normal(ks[0], (vocab, d), _F32) * s,
+        "pos": jax.random.normal(ks[1], (max_len, d), _F32) * s,
+        "ln_f": jnp.ones((d,), _F32),
+        "layers": [],
+    }
+    for i in range(layers):
+        lk = jax.random.split(ks[2 + i], 6)
+        params["layers"].append({
+            "ln1": jnp.ones((d,), _F32),
+            "ln2": jnp.ones((d,), _F32),
+            "wq": jax.random.normal(lk[0], (d, d), _F32) * s,
+            "wk": jax.random.normal(lk[1], (d, d), _F32) * s,
+            "wv": jax.random.normal(lk[2], (d, d), _F32) * s,
+            "wo": jax.random.normal(lk[3], (d, d), _F32) * s,
+            "w1": jax.random.normal(lk[4], (d, 4 * d), _F32) * s,
+            "w2": jax.random.normal(lk[5], (4 * d, d), _F32) * s,
+        })
+    return params
+
+
+def _ln(x, scale):
+    m = jnp.mean(x, axis=-1, keepdims=True)
+    v = jnp.var(x, axis=-1, keepdims=True)
+    return (x - m) * jax.lax.rsqrt(v + 1e-5) * scale
+
+
+class TinyDecoderLM:
+    grows_kv = True
+    state_specs: List[Tuple[tuple, type]] = []   # position == KV length
+
+    def __init__(self, vocab: int = 64, d_model: int = 32,
+                 num_heads: int = 4, num_layers: int = 2,
+                 max_len: int = 64, num_pages: int = 32,
+                 page_size: int = 8, pages_per_seq: int = 8,
+                 bos_id: int = 1, eos_id: int = 0, seed: int = 0):
+        self.vocab, self.d = int(vocab), int(d_model)
+        self.heads = int(num_heads)
+        self.dh = self.d // self.heads
+        self.layers = int(num_layers)
+        self.max_len = int(max_len)
+        self.page_size = int(page_size)
+        self.pages_per_seq = int(pages_per_seq)
+        self.bos_id, self.eos_id = int(bos_id), int(eos_id)
+        self.allocator = PageAllocator(num_pages)
+        self.params = _init_params(jax.random.key(seed), vocab, self.d,
+                                   self.heads, self.layers, self.max_len)
+        shape = (self.layers, num_pages, self.page_size, self.heads, self.dh)
+        self.k_pool = jnp.zeros(shape, _F32)
+        self.v_pool = jnp.zeros(shape, _F32)
+
+    # -- dense forward (prefill + test oracle) ------------------------------
+
+    def _forward(self, tokens: jnp.ndarray):
+        """Full dense causal forward over (T,) tokens -> (logits (T, V),
+        per-layer K/V rows (L, T, heads, dh))."""
+        p = self.params
+        T = tokens.shape[0]
+        x = p["emb"][tokens] + p["pos"][:T]
+        ks, vs = [], []
+        for lp in p["layers"]:
+            h = _ln(x, lp["ln1"])
+            q = (h @ lp["wq"]).reshape(T, self.heads, self.dh)
+            k = (h @ lp["wk"]).reshape(T, self.heads, self.dh)
+            v = (h @ lp["wv"]).reshape(T, self.heads, self.dh)
+            ks.append(k)
+            vs.append(v)
+            a = dense_prefill_attention(q, k, v, causal=True)
+            x = x + a.reshape(T, self.d) @ lp["wo"]
+            h2 = _ln(x, lp["ln2"])
+            x = x + jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
+        logits = _ln(x, p["ln_f"]) @ p["emb"].T
+        return logits, jnp.stack(ks), jnp.stack(vs)
+
+    def dense_greedy(self, prompt: Sequence[int],
+                     max_new_tokens: int) -> List[int]:
+        """The no-cache oracle: re-run the full forward per token."""
+        ids = list(prompt)
+        out = []
+        for _ in range(max_new_tokens):
+            logits, _, _ = self._forward(jnp.asarray(ids, jnp.int32))
+            tok = int(jnp.argmax(logits[-1]))
+            out.append(tok)
+            if tok == self.eos_id:
+                break
+            ids.append(tok)
+        return out
+
+    # -- session contract ---------------------------------------------------
+
+    def context_pages(self, prompt, max_new_tokens: int) -> int:
+        total = len(prompt) + int(max_new_tokens)
+        return max(1, -(-total // self.page_size))
+
+    def pool_table(self, pages: Sequence[int]) -> np.ndarray:
+        t = np.zeros((self.pages_per_seq,), np.int32)
+        t[:len(pages)] = np.asarray(pages, np.int32)
+        return t
+
+    def prefill(self, prompt: Sequence[int], pages: Sequence[int]):
+        toks = jnp.asarray(list(prompt), jnp.int32)
+        logits, ks, vs = self._forward(toks)
+        T = toks.shape[0]
+        cap = len(pages) * self.page_size
+        pad = cap - T
+        idx = jnp.asarray(np.asarray(pages, np.int32))
+        kr = jnp.pad(ks, ((0, 0), (0, pad), (0, 0), (0, 0))).reshape(
+            self.layers, len(pages), self.page_size, self.heads, self.dh)
+        vr = jnp.pad(vs, ((0, 0), (0, pad), (0, 0), (0, 0))).reshape(
+            self.layers, len(pages), self.page_size, self.heads, self.dh)
+        self.k_pool = self.k_pool.at[:, idx].set(kr)
+        self.v_pool = self.v_pool.at[:, idx].set(vr)
+        return int(T), [], logits[-1]
+
+    def decode(self, tokens: np.ndarray, states, tables: np.ndarray,
+               lens: np.ndarray):
+        logits, self.k_pool, self.v_pool = _decode_step(
+            self.params, self.k_pool, self.v_pool,
+            jnp.asarray(tables.astype(np.int32)),
+            jnp.asarray(lens.astype(np.int32)),
+            jnp.asarray(tokens[:, 0].astype(np.int32)),
+            heads=self.heads, page_size=self.page_size)
+        return np.asarray(logits), []
+
+
+@functools.partial(jax.jit, static_argnames=("heads", "page_size"))
+def _decode_step(params, k_pool, v_pool, tables, lens, tokens, *,
+                 heads, page_size):
+    """One token for every slot: append K/V into pages, attend over the
+    page tables.  Fixed-shape in every argument — compiled once."""
+    S = tokens.shape[0]
+    L, N, pg, H, dh = k_pool.shape
+    d = H * dh
+    x = params["emb"][tokens] + params["pos"][lens]        # (S, d)
+    # flat pool row each slot's new KV lands in: its page at
+    # lens // page_size, offset lens % page_size.  Inactive slots hold
+    # the null table -> they scribble on reserved page 0, harmlessly.
+    flat = (tables[jnp.arange(S), lens // page_size] * page_size
+            + lens % page_size)                            # (S,)
+    for li, lp in enumerate(params["layers"]):
+        h = _ln(x, lp["ln1"])
+        q = (h @ lp["wq"]).reshape(S, H, dh)
+        k = (h @ lp["wk"]).reshape(S, H, dh)
+        v = (h @ lp["wv"]).reshape(S, H, dh)
+        k_pool = k_pool.at[li].set(
+            k_pool[li].reshape(N * pg, H, dh).at[flat].set(k)
+            .reshape(N, pg, H, dh))
+        v_pool = v_pool.at[li].set(
+            v_pool[li].reshape(N * pg, H, dh).at[flat].set(v)
+            .reshape(N, pg, H, dh))
+        a = paged_attention(q, k_pool[li], v_pool[li], tables, lens + 1)
+        x = x + a.reshape(S, d) @ lp["wo"]
+        h2 = _ln(x, lp["ln2"])
+        x = x + jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
+    logits = _ln(x, params["ln_f"]) @ params["emb"].T
+    return logits, k_pool, v_pool

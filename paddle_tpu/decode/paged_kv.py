@@ -1,0 +1,194 @@
+"""Block-paged KV storage: host-side allocator + device pool.
+
+The pool is one preallocated device array of ``num_pages`` fixed-size
+pages; sequences own disjoint page sets named by their page table, so
+ragged contexts share the allocation with zero per-sequence reshapes.
+The allocator is pure host bookkeeping (a free list); exhaustion is an
+*admission* signal (``PoolExhausted``) so the scheduler refuses new
+sequences instead of corrupting live ones — the graceful-degradation
+twin of the serving engine's 503 path.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu.observability import metrics as _metrics
+
+_M_PAGES_IN_USE = _metrics.gauge(
+    "decode_pages_in_use", "KV-cache pages currently owned by sequences")
+_M_PAGE_ALLOCS = _metrics.counter(
+    "decode_page_allocs_total", "pages handed out by the allocator")
+_M_PAGE_FREES = _metrics.counter(
+    "decode_page_frees_total", "pages returned to the allocator free list")
+
+
+class PoolExhausted(RuntimeError):
+    """The page pool cannot satisfy an allocation: refuse admission."""
+
+
+class PageAllocator:
+    """Free-list page allocator.  Pages are ints in [0, num_pages).
+
+    Page 0 is reserved as the *null page*: inactive slots' page tables
+    point at it, so a fixed-shape gather never indexes freed memory.
+    """
+
+    NULL_PAGE = 0
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is reserved)")
+        self.num_pages = int(num_pages)
+        # LIFO free list: a just-freed (still-hot) page is reused first
+        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+        self._in_use = 0
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self._in_use
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        """Take ``n`` pages or raise ``PoolExhausted`` (taking none)."""
+        if n > len(self._free):
+            raise PoolExhausted(
+                f"page pool exhausted: need {n} pages, "
+                f"{len(self._free)} free of {self.num_pages - 1} usable")
+        pages = [self._free.pop() for _ in range(n)]
+        self._in_use += n
+        _M_PAGE_ALLOCS.inc(n)
+        _M_PAGES_IN_USE.set(self._in_use)
+        return pages
+
+    def free(self, pages: Sequence[int]) -> None:
+        seen = set(self._free)
+        for p in pages:
+            if p == self.NULL_PAGE:
+                raise ValueError("cannot free the reserved null page")
+            # `seen` grows within the call: a duplicate inside ONE
+            # free() is the same double-free corruption as across two
+            if p in seen or not (0 < p < self.num_pages):
+                raise ValueError(f"double free / bad page id {p}")
+            seen.add(p)
+        self._free.extend(pages)
+        self._in_use -= len(pages)
+        _M_PAGE_FREES.inc(len(pages))
+        _M_PAGES_IN_USE.set(self._in_use)
+
+
+def _scatter_pages(pool, idx, buf):
+    return pool.at[idx].set(buf)
+
+
+def _scatter_row(pool, page, off, row):
+    return pool.at[page, off].set(row)
+
+
+class PagedPool:
+    """Device-resident page pool: ``(num_pages, page_size) + feature``.
+
+    The array lives as a ``jax.Array`` and is updated functionally —
+    every write returns the new pool value, which callers feed back
+    into the fixed-shape decode program (feeding a device array is
+    zero-copy through the executor's feed conversion).  Writes go
+    through jitted scatters (one compile per page-count, then ~50us
+    dispatches): an eager ``.at[].set`` costs ~0.6 ms per call on CPU,
+    which dominated per-sequence prefill before batching even starts.
+    """
+
+    def __init__(self, num_pages: int, page_size: int,
+                 feature_shape: Tuple[int, ...], dtype="float32"):
+        import jax.numpy as jnp
+
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.feature_shape = tuple(int(d) for d in feature_shape)
+        self.allocator = PageAllocator(num_pages)
+        self.data = jnp.zeros(
+            (self.num_pages, self.page_size) + self.feature_shape, dtype)
+        import jax
+
+        # NOT donated: donated buffers interact badly with the
+        # persistent XLA compile cache on this jax version (cache-
+        # loaded executables mis-apply the aliasing — observed as both
+        # corrupted weights and later native crashes in long suites).
+        # The pool copy per write is ~pool-size and off the per-token
+        # path (one write per admission / appended row).
+        self._scatter = jax.jit(_scatter_pages)
+        self._scatter_one = jax.jit(_scatter_row)
+
+    def pages_for(self, length: int) -> int:
+        """Pages needed to hold ``length`` rows."""
+        return max(1, -(-int(length) // self.page_size))
+
+    def write_rows(self, pages: Sequence[int], rows: np.ndarray) -> None:
+        """Write ``rows`` (T, *feature) into ``pages`` front-to-back,
+        zero-padding the final partial page."""
+        import jax.numpy as jnp
+
+        n = len(pages)
+        cap = n * self.page_size
+        if rows.shape[0] > cap:
+            raise ValueError(
+                f"{rows.shape[0]} rows do not fit {n} pages "
+                f"({cap} row capacity)")
+        buf = np.zeros((cap,) + self.feature_shape, self.data.dtype)
+        buf[:rows.shape[0]] = rows
+        buf = buf.reshape((n, self.page_size) + self.feature_shape)
+        self.data = self._scatter(
+            self.data, jnp.asarray(np.asarray(pages, np.int32)), buf)
+
+    def append_row(self, pages: Sequence[int], position: int,
+                   row: np.ndarray) -> None:
+        """Write one row at logical ``position`` within the sequence's
+        pages (the growing-KV decode case)."""
+        page = pages[position // self.page_size]
+        off = position % self.page_size
+        self.data = self._scatter_one(
+            self.data, np.int32(page), np.int32(off),
+            np.asarray(row, self.data.dtype))
+
+    def page_table(self, pages: Sequence[int], width: int) -> np.ndarray:
+        """Fixed-width page-table row, null-padded past the owned pages."""
+        t = np.full((width,), PageAllocator.NULL_PAGE, np.int32)
+        t[:len(pages)] = np.asarray(pages, np.int32)
+        return t
+
+
+class SequencePages:
+    """One sequence's page ownership + logical length."""
+
+    __slots__ = ("pages", "length", "capacity")
+
+    def __init__(self, pages: List[int], length: int, page_size: int):
+        self.pages = pages
+        self.length = int(length)
+        self.capacity = len(pages) * page_size
+
+    def grow_needed(self) -> bool:
+        return self.length >= self.capacity
+
+
+def alloc_sequence(pool: PagedPool, length: int,
+                   reserve_growth: int = 0) -> SequencePages:
+    """Allocate pages for a ``length``-row context (+ optional headroom
+    for per-step KV growth).  Raises ``PoolExhausted`` without partial
+    allocation."""
+    n = pool.pages_for(max(1, length + reserve_growth))
+    pages = pool.allocator.alloc(n)
+    return SequencePages(pages, length, pool.page_size)
+
+
+def free_sequence(pool: PagedPool, seq: Optional[SequencePages]) -> None:
+    if seq is not None and seq.pages:
+        pool.allocator.free(seq.pages)
+        seq.pages = []

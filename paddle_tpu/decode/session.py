@@ -1,0 +1,362 @@
+"""DecodeSession: continuous batching at token granularity.
+
+The session owns ``max_slots`` fixed batch lanes.  Every scheduler tick
+(``step()``):
+
+1. **Admit**: pending requests claim open slots while the page pool can
+   hold their whole context (prompt + every token they may generate —
+   reserved up front, so a running sequence can never hit mid-flight
+   exhaustion).  Admission runs the model's prefill and writes the
+   context into freshly allocated pages.
+2. **Decode**: ONE fixed-shape step over all ``max_slots`` lanes —
+   inactive lanes ride along masked (their page tables point at the
+   reserved null page), so the compiled program's shapes never change
+   as the batch composition churns and the executor compile cache hits
+   every step.
+3. **Evict**: finished sequences (EOS or token budget) leave their
+   slot, their pages return to the allocator free list, and their
+   waiter is notified.
+
+The model behind the session is pluggable (``PagedSeq2SeqModel`` for
+v1 beam_search specs, ``TinyDecoderLM`` for transformer self-attention
+KV); ``generation.py``'s greedy path is the exact dense oracle the
+parity tests pin this against.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from paddle_tpu.decode.paged_kv import PoolExhausted
+from paddle_tpu.observability import metrics as _metrics
+
+_M_ACTIVE = _metrics.gauge(
+    "decode_active_slots", "sequences currently decoding in the session")
+_M_WAITING = _metrics.gauge(
+    "decode_waiting_requests", "admitted-but-queued generation requests")
+_M_STEPS = _metrics.counter(
+    "decode_steps_total", "fixed-shape decode steps dispatched")
+_M_TOKENS = _metrics.counter(
+    "decode_tokens_total", "tokens generated across all sequences")
+_M_REFUSED = _metrics.counter(
+    "decode_admission_refused_total",
+    "generation requests refused at admission, by reason")
+_M_STEP_SEC = _metrics.histogram(
+    "decode_step_seconds", "wall time per batched decode step")
+_M_PREFILL_SEC = _metrics.histogram(
+    "decode_prefill_seconds", "wall time per sequence prefill (admission)")
+_M_TTFT = _metrics.histogram(
+    "decode_ttft_seconds", "submit-to-first-token latency per sequence")
+_M_REQ_SEC = _metrics.histogram(
+    "decode_request_seconds", "submit-to-finish latency per sequence")
+
+
+class AdmissionRefused(RuntimeError):
+    """The session cannot take this request (pool exhausted / too long
+    / queue full).  Serving maps this to 503 — graceful refusal, never
+    a crash of live sequences."""
+
+    def __init__(self, reason: str, message: str):
+        super().__init__(message)
+        self.reason = reason
+
+
+class DecodeRequest:
+    """One generation request: prompt in, streamed tokens out."""
+
+    def __init__(self, prompt, max_new_tokens: int = 32,
+                 on_token: Optional[Callable[[int], None]] = None,
+                 deadline: Optional[float] = None):
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.on_token = on_token
+        self.deadline = deadline            # time.monotonic timestamp
+        self.tokens: List[int] = []
+        self.error: Optional[BaseException] = None
+        self.finish_reason: Optional[str] = None   # eos|length|deadline|error
+        self.submitted_at = time.monotonic()
+        self.first_token_at: Optional[float] = None
+        self._done = threading.Event()
+
+    # -- waiter side --------------------------------------------------------
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        if not self.wait(timeout):
+            raise TimeoutError("generation did not finish in time")
+        if self.error is not None:
+            raise self.error
+        return list(self.tokens)
+
+    # -- session side -------------------------------------------------------
+
+    def _emit(self, token: int) -> None:
+        now = time.monotonic()
+        if self.first_token_at is None:
+            self.first_token_at = now
+            _M_TTFT.observe(now - self.submitted_at)
+        self.tokens.append(int(token))
+        if self.on_token is not None:
+            try:
+                self.on_token(int(token))
+            except Exception:
+                pass  # a dead stream consumer must not kill the batch
+
+    def _finish(self, reason: str,
+                error: Optional[BaseException] = None) -> None:
+        if self._done.is_set():
+            return
+        self.finish_reason = reason
+        self.error = error
+        _M_REQ_SEC.observe(time.monotonic() - self.submitted_at)
+        self._done.set()
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now > self.deadline
+
+
+class _Slot:
+    __slots__ = ("req", "pages", "ctx_len", "new_tokens")
+
+    def __init__(self, req: DecodeRequest, pages: List[int], ctx_len: int):
+        self.req = req
+        self.pages = pages
+        self.ctx_len = int(ctx_len)
+        self.new_tokens = 0
+
+
+class DecodeSession:
+    """Token-granularity continuous batching over a paged model.
+
+    ``model`` contract (duck-typed; see seq2seq.PagedSeq2SeqModel and
+    model.TinyDecoderLM):
+
+    - ``allocator``/``page_size``/``pages_per_seq``: paging geometry
+    - ``bos_id``/``eos_id``: token conventions
+    - ``grows_kv``: True when each decode step appends one KV row
+      (transformer self-attention) — the session then reserves pages
+      for prompt+budget at admission and advances lengths per step
+    - ``context_pages(prompt, max_new) -> int``: pages to reserve
+    - ``prefill(prompt, pages) -> (ctx_len, state_rows, first_logits)``
+      where ``state_rows`` is one row per state buffer and
+      ``first_logits`` (or None) scores the first generated token
+    - ``state_specs -> [(row_shape, dtype), ...]``
+    - ``decode(tokens (S,1), states, page_tables (S,P), lens (S,))
+      -> (logits (S,V), new_states)``
+    """
+
+    def __init__(self, model, max_slots: int = 8,
+                 max_waiting: Optional[int] = None):
+        self.model = model
+        self.max_slots = int(max_slots)
+        self.max_waiting = max_waiting
+        self._lock = threading.Lock()
+        self._pending: List[DecodeRequest] = []
+        self._slots: List[Optional[_Slot]] = [None] * self.max_slots
+        S = self.max_slots
+        P = model.pages_per_seq
+        self._tokens = np.full((S, 1), model.bos_id, np.int64)
+        self._tables = np.full((S, P), 0, np.int32)   # null page
+        self._lens = np.ones((S,), np.int64)
+        self._states = [np.zeros((S,) + tuple(shape), dtype)
+                        for shape, dtype in model.state_specs]
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, req: DecodeRequest) -> DecodeRequest:
+        """Queue a request; raises AdmissionRefused when it can never
+        run (too long for the pool) or the wait queue is full."""
+        need = self.model.context_pages(req.prompt, req.max_new_tokens)
+        usable = self.model.allocator.num_pages - 1
+        if need > min(usable, self.model.pages_per_seq):
+            _M_REFUSED.inc(reason="too_long")
+            raise AdmissionRefused(
+                "too_long",
+                f"request needs {need} pages; a sequence may hold at most "
+                f"{min(usable, self.model.pages_per_seq)}")
+        with self._lock:
+            if (self.max_waiting is not None
+                    and len(self._pending) >= self.max_waiting):
+                _M_REFUSED.inc(reason="queue_full")
+                raise AdmissionRefused(
+                    "queue_full",
+                    f"admission queue is full ({self.max_waiting} waiting)")
+            self._pending.append(req)
+            _M_WAITING.set(len(self._pending))
+        return req
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def active(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._slots if s is not None)
+
+    @property
+    def waiting(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def idle(self) -> bool:
+        with self._lock:
+            return not self._pending and all(s is None
+                                             for s in self._slots)
+
+    # -- scheduler tick -----------------------------------------------------
+
+    def step(self) -> int:
+        """One tick: admit -> decode -> evict.  Returns the number of
+        slots that were active during the decode dispatch (0 = idle,
+        nothing dispatched)."""
+        self._admit()
+        active_idx = [i for i, s in enumerate(self._slots) if s is not None]
+        if not active_idx:
+            return 0
+        t0 = time.perf_counter()
+        logits, new_states = self.model.decode(
+            self._tokens, self._states, self._tables, self._lens)
+        _M_STEP_SEC.observe(time.perf_counter() - t0)
+        _M_STEPS.inc()
+        logits = np.asarray(logits)
+        for i, buf in enumerate(self._states):
+            buf[...] = np.asarray(new_states[i])
+        if self.model.grows_kv:
+            for i in active_idx:
+                self._slots[i].ctx_len += 1
+                self._lens[i] = self._slots[i].ctx_len
+        now = time.monotonic()
+        for i in active_idx:
+            slot = self._slots[i]
+            if slot.req.expired(now):
+                self._evict(i, "deadline",
+                            TimeoutError("generation deadline expired"))
+                continue
+            tok = int(np.argmax(logits[i]))
+            self._emit_token(i, tok)
+        _M_ACTIVE.set(self.active)
+        return len(active_idx)
+
+    def run(self, max_steps: Optional[int] = None) -> None:
+        """Drive the session until every queued request finishes (the
+        offline / benchmark entry; serving uses a background thread
+        around ``step``)."""
+        steps = 0
+        while not self.idle():
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(
+                    f"decode loop did not drain in {max_steps} steps")
+
+    # -- internals ----------------------------------------------------------
+
+    def _emit_token(self, i: int, tok: int) -> None:
+        slot = self._slots[i]
+        slot.req._emit(tok)
+        slot.new_tokens += 1
+        _M_TOKENS.inc()
+        if tok == self.model.eos_id:
+            self._evict(i, "eos")
+        elif slot.new_tokens >= slot.req.max_new_tokens:
+            self._evict(i, "length")
+        else:
+            self._tokens[i, 0] = tok
+
+    def _sweep_expired(self) -> None:
+        """Fail queued requests whose deadline passed.  Runs every tick
+        — even with zero free slots — so dead waiters release their
+        max_waiting capacity instead of causing spurious queue_full
+        refusals while they wait for an eviction."""
+        now = time.monotonic()
+        with self._lock:
+            live, dead = [], []
+            for req in self._pending:
+                (dead if req.expired(now) else live).append(req)
+            self._pending = live
+            _M_WAITING.set(len(live))
+        for req in dead:
+            req._finish("deadline", TimeoutError(
+                "generation deadline expired while queued"))
+
+    def _admit(self) -> None:
+        self._sweep_expired()
+        while True:
+            free = next((i for i, s in enumerate(self._slots)
+                         if s is None), None)
+            if free is None:
+                return
+            with self._lock:
+                req = self._pending.pop(0) if self._pending else None
+                _M_WAITING.set(len(self._pending))
+            if req is None:
+                return
+            need = self.model.context_pages(req.prompt, req.max_new_tokens)
+            if not self.model.allocator.can_alloc(need):
+                # pages are busy with live sequences: requeue at the
+                # head — an evict next tick frees them.  Not a refusal;
+                # refusal happens at submit (never fits / queue full).
+                with self._lock:
+                    self._pending.insert(0, req)
+                    _M_WAITING.set(len(self._pending))
+                return
+            try:
+                t0 = time.perf_counter()
+                pages = self.model.allocator.alloc(need)
+                try:
+                    ctx_len, state_rows, first_logits = self.model.prefill(
+                        req.prompt, pages)
+                except BaseException:
+                    self.model.allocator.free(pages)
+                    raise
+                _M_PREFILL_SEC.observe(time.perf_counter() - t0)
+            except PoolExhausted as e:   # raced with another allocator user
+                _M_REFUSED.inc(reason="pool_exhausted")
+                req._finish("error", AdmissionRefused("pool_exhausted",
+                                                      str(e)))
+                continue
+            except BaseException as e:
+                req._finish("error", e)
+                continue
+            slot = _Slot(req, pages, ctx_len)
+            self._slots[free] = slot
+            self._tables[free] = self.model.pool_table(pages)
+            self._lens[free] = ctx_len
+            self._tokens[free, 0] = self.model.bos_id
+            for buf, row in zip(self._states, state_rows):
+                buf[free] = row
+            if first_logits is not None:
+                tok = int(np.argmax(np.asarray(first_logits)))
+                self._emit_token(free, tok)
+            _M_ACTIVE.set(self.active)
+
+    def _evict(self, i: int, reason: str,
+               error: Optional[BaseException] = None) -> None:
+        slot = self._slots[i]
+        self._slots[i] = None
+        self._tables[i] = 0
+        self._lens[i] = 1
+        self._tokens[i, 0] = self.model.bos_id
+        if slot.pages:
+            self.model.allocator.free(slot.pages)
+            slot.pages = []
+        slot.req._finish(reason, error)
+
+    def fail_all(self, exc: BaseException) -> None:
+        """Shutdown: fail every live and queued request."""
+        with self._lock:
+            pending, self._pending = self._pending, []
+        for req in pending:
+            req._finish("error", exc)
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                self._evict(i, "error", exc)

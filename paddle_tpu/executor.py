@@ -66,6 +66,25 @@ _M_FETCH_BYTES = _metrics.counter(
     "bytes copied device->host materializing return_numpy fetches")
 
 
+def _donation_ok() -> bool:
+    """Whether jit state donation is safe in this process.
+
+    jax 0.4.37's persistent compilation cache deserializes executables
+    with broken input-output aliasing: a cache-loaded executable for a
+    structurally-identical program reads its donated state as garbage
+    (reproduced: a second SequenceGenerator over cloned weights decodes
+    noise, and long suites crash natively in later tests).  Donation is
+    a perf feature — skip it whenever the persistent cache is enabled;
+    everything still runs, state updates just copy instead of aliasing.
+    """
+    try:
+        if jax.config.jax_compilation_cache_dir:
+            return False
+    except AttributeError:  # pragma: no cover - future jax renames
+        pass
+    return True
+
+
 def _fetch_nbytes(v) -> int:
     """Host bytes a converted fetch value occupies."""
     if isinstance(v, LoDArray):
@@ -586,7 +605,8 @@ class Executor:
             return _Compiled(run_block, state_names, written_names, fetch_names,
                              uses_rng)
 
-        jit_kwargs: Dict[str, Any] = {"donate_argnums": (0,)}
+        jit_kwargs: Dict[str, Any] = (
+            {"donate_argnums": (0,)} if _donation_ok() else {})
         if self.strategy is not None:
             jit_kwargs.update(
                 self.strategy.jit_shardings(

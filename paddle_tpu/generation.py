@@ -93,13 +93,60 @@ class BeamGen:
         return [s.input for s in self.static_ins]
 
 
+def build_boot_vars(beam_gen: BeamGen, ctx: dict) -> List:
+    """Build each memory's boot expression in ``ctx``; ``None`` means a
+    zero boot.  Shared by the dense generator below and the paged
+    decode adapter (decode/seq2seq.py)."""
+    from paddle_tpu.v2.layer import SeqVal
+
+    boot_vars = []
+    for m in beam_gen.memories:
+        if m.parents:
+            bv = m.parents[0].build(ctx)
+            bv = bv.var if isinstance(bv, SeqVal) else bv
+        else:
+            bv = None
+        boot_vars.append(bv)
+    return boot_vars
+
+
+def resolve_new_state_vars(beam_gen: BeamGen, sub_ctx: dict) -> List:
+    """For each memory, the step-graph value its link names — the
+    next-step state to fetch."""
+    from paddle_tpu.v2.layer import SeqVal
+
+    out = []
+    for m in beam_gen.memories:
+        linked = beam_gen._by_name.get(m._mem_link)
+        if linked is None:
+            raise KeyError(f"memory link {m._mem_link!r} not found")
+        lv = sub_ctx.get(id(linked))
+        if lv is None:
+            lv = linked.build(sub_ctx)
+        out.append(lv.var if isinstance(lv, SeqVal) else lv)
+    return out
+
+
+def run_startup_for_missing(exe, scope, *startups) -> None:
+    """Run startup programs initializing ONLY vars absent from
+    ``scope``: generation reuses trained parameters by name (the
+    reference loaded the merged model by parameter name; clobbering
+    them with the startup initializers would silently decode from
+    random weights)."""
+    for startup in startups:
+        blk = startup.global_block()
+        blk.ops = [op for op in blk.ops
+                   if any(scope.find_var(n) is None
+                          for n in op.output_arg_names)]
+        exe.run(startup, scope=scope)
+
+
 class SequenceGenerator:
     """Builds the init/step programs once and generates with host-side
     beam search (reference: SWIG SequenceGenerator, api/PaddleAPI.h:546;
     RecurrentGradientMachine beam loop)."""
 
     def __init__(self, beam_gen: BeamGen, parameters):
-        from paddle_tpu import executor as executor_mod
         from paddle_tpu import framework
         from paddle_tpu import layers as L
         from paddle_tpu.executor import Executor
@@ -130,7 +177,7 @@ class SequenceGenerator:
 
             # memory state feeds + boot exprs
             self._state_names = []
-            self._boot_vars = []
+            self._boot_vars = build_boot_vars(beam_gen, ctx)
             sub_ctx = {id(beam_gen._word_ph): emb}
             for ph, v in zip(beam_gen._static_phs, static_vals):
                 sub_ctx[id(ph)] = v
@@ -140,53 +187,47 @@ class SequenceGenerator:
                             append_batch_size=False)
                 self._state_names.append(sname)
                 sub_ctx[id(m)] = sv
-                if m.parents:
-                    bv = m.parents[0].build(ctx)
-                    bv = bv.var if isinstance(bv, SeqVal) else bv
-                else:
-                    bv = None
-                self._boot_vars.append(bv)
 
             out = beam_gen.step_out.build(sub_ctx)
             self._probs_var = out.var if isinstance(out, SeqVal) else out
-            self._new_state_vars = []
-            for m in beam_gen.memories:
-                linked = beam_gen._by_name.get(m._mem_link)
-                if linked is None:
-                    raise KeyError(f"memory link {m._mem_link!r} not found")
-                lv = sub_ctx.get(id(linked))
-                if lv is None:
-                    lv = linked.build(sub_ctx)
-                self._new_state_vars.append(
-                    lv.var if isinstance(lv, SeqVal) else lv)
+            self._new_state_vars = resolve_new_state_vars(beam_gen, sub_ctx)
 
         self._exe = Executor(TPUPlace())
         self._scope = parameters.scope
-        # initialize ONLY vars absent from the shared scope: generation
-        # reuses the trained parameters by name (the reference loaded
-        # the merged model by parameter name; clobbering them with the
-        # startup initializers would silently decode from random
-        # weights)
-        blk = self._startup.global_block()
-        blk.ops = [op for op in blk.ops
-                   if any(self._scope.find_var(n) is None
-                          for n in op.output_arg_names)]
-        with executor_mod.scope_guard(self._scope):
-            self._exe.run(self._startup)
+        run_startup_for_missing(self._exe, self._scope, self._startup)
 
     def _run(self, feed, fetch):
-        from paddle_tpu import executor as executor_mod
+        # scope passed explicitly, NOT via scope_guard: the guard
+        # mutates the process-global scope stack, and concurrent
+        # generators (the serving fallback runs one per worker thread)
+        # would race on it
+        return self._exe.run(self._main, feed=feed, fetch_list=fetch,
+                             scope=self._scope)
 
-        with executor_mod.scope_guard(self._scope):
-            return self._exe.run(self._main, feed=feed, fetch_list=fetch)
+    def _base_feed(self, row):
+        return self._feeder.feed([row]) if self._feed_types else {}
 
-    def generate(self, row) -> List[tuple]:
+    def generate(self, row, beam_size: Optional[int] = None,
+                 max_length: Optional[int] = None) -> List[tuple]:
         """Generate for ONE input row (the static-input fields, v2
         reader order).  Returns the beam as [(score, [ids...]), ...]
-        best-first; ids exclude bos and include eos if produced."""
+        best-first; ids exclude bos and include eos if produced.
+
+        ``beam_size``/``max_length`` override the spec per call WITHOUT
+        rebuilding anything: the init/step programs are built once in
+        ``__init__`` and the beam width only changes the step feed's
+        batch dimension, so the executor compile cache keys the step by
+        shape — switching widths costs one compile per distinct width,
+        and repeated calls at any previously-seen width are pure cache
+        hits (previously each width needed a fresh SequenceGenerator,
+        whose fresh uname'd programs re-traced from scratch)."""
         bg = self.bg
-        k = bg.beam_size
-        base = self._feeder.feed([row]) if self._feed_types else {}
+        k = int(beam_size) if beam_size is not None else bg.beam_size
+        if k < 1:
+            raise ValueError(f"beam_size must be >= 1, got {k}")
+        steps = (int(max_length) if max_length is not None
+                 else bg.max_length)
+        base = self._base_feed(row)
 
         def tile(arr):
             return np.repeat(np.asarray(arr), k, axis=0)
@@ -210,7 +251,7 @@ class SequenceGenerator:
         alive = np.ones((k,), bool)
         seqs = [[] for _ in range(k)]
 
-        for _ in range(bg.max_length):
+        for _ in range(steps):
             feed = dict(feed_k)
             feed["@gen_word"] = tokens
             for n, s in zip(self._state_names, states):
@@ -266,3 +307,42 @@ class SequenceGenerator:
         order = np.argsort(-scores)
         return [(float(scores[i]), list(seqs[i])) for i in order
                 if np.isfinite(scores[i])]
+
+    def generate_greedy(self, row,
+                        max_length: Optional[int] = None) -> List[int]:
+        """Dense greedy decode for ONE row: argmax token per step, stop
+        at eos or the length budget.  This is the exact oracle the
+        paged-KV decode subsystem (paddle_tpu/decode) pins its
+        token-for-token parity tests against — same step program, one
+        sequence, no paging."""
+        bg = self.bg
+        steps = (int(max_length) if max_length is not None
+                 else bg.max_length)
+        base = self._base_feed(row)
+        feed_1 = {n: np.asarray(v) for n, v in base.items()}
+
+        states = []
+        boot_fetch = [v for v in self._boot_vars if v is not None]
+        boots = iter(self._run(feed_1, boot_fetch) if boot_fetch else [])
+        for m, bv in zip(bg.memories, self._boot_vars):
+            if bv is None:
+                states.append(np.zeros((1, m.size), np.float32))
+            else:
+                states.append(np.asarray(next(boots)).reshape(1, -1)
+                              .astype(np.float32))
+
+        token = bg.bos_id
+        out: List[int] = []
+        for _ in range(steps):
+            feed = dict(feed_1)
+            feed["@gen_word"] = np.asarray([[token]], np.int64)
+            for n, s in zip(self._state_names, states):
+                feed[n] = s.astype(np.float32)
+            outs = self._run(feed, [self._probs_var] + self._new_state_vars)
+            probs = np.asarray(outs[0]).reshape(-1)
+            states = [np.asarray(o) for o in outs[1:]]
+            token = int(np.argmax(probs))
+            out.append(token)
+            if token == bg.eos_id:
+                break
+        return out

@@ -11,7 +11,8 @@ from paddle_tpu.registry import OpRegistry, register_op
 def _alias_op(alias: str, target: str, inputs, outputs=("Out",)):
     info = OpRegistry.get(target)
     register_op(alias, inputs=inputs, outputs=outputs,
-                diff_inputs=info.diff_inputs)(info.lower)
+                diff_inputs=info.diff_inputs,
+                infer_shape=info.infer_shape)(info.lower)
 
 
 _alias_op("conv2d_cudnn", "conv2d", ("Input", "Filter"), ("Output",))

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from paddle_tpu.lod import LoDArray, rewrap, row_segment_ids, unwrap
+from paddle_tpu.ops.nn_ops import _make_pool_infer
 from paddle_tpu.registry import register_op
 
 NEG_INF = -1e9
@@ -227,7 +228,9 @@ def _conv_shift(ctx):
     ctx.set_output("Out", jnp.einsum("bnm,bm->bn", x[:, idx], y))
 
 
-@register_op("max_pool2d_with_index", inputs=("X",), outputs=("Out", "Mask"))
+@register_op("max_pool2d_with_index", inputs=("X",), outputs=("Out", "Mask"),
+             infer_shape=_make_pool_infer(2, default_strides=(2, 2),
+                                          also=("Mask",)))
 def _max_pool2d_with_index(ctx):
     x = unwrap(ctx.input("X"))
     ks = tuple(ctx.attr("ksize", (2, 2)))
@@ -274,7 +277,7 @@ def _unpool(ctx):
     ctx.set_output("Out", out.reshape(B, C, H, W))
 
 
-@register_op("pool3d", inputs=("X",))
+@register_op("pool3d", inputs=("X",), infer_shape=_make_pool_infer(3))
 def _pool3d(ctx):
     x = unwrap(ctx.input("X"))
     ks = tuple(ctx.attr("ksize", (2, 2, 2)))
@@ -408,7 +411,9 @@ def _sequence_slice(ctx):
     ctx.set_output("Out", LoDArray(new_data, (new_off,)))
 
 
-@register_op("max_pool3d_with_index", inputs=("X",), outputs=("Out", "Mask"))
+@register_op("max_pool3d_with_index", inputs=("X",), outputs=("Out", "Mask"),
+             infer_shape=_make_pool_infer(3, default_strides="ksize",
+                                          also=("Mask",)))
 def _max_pool3d_with_index(ctx):
     """3-D max pool emitting global flat D*H*W argmax indices
     (reference: operators/pool_with_index_op.cc, 3-D registration)."""

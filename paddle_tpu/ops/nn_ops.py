@@ -13,7 +13,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from paddle_tpu.lod import rewrap, unwrap
-from paddle_tpu.registry import register_op
+from paddle_tpu.registry import SkipInferShape, register_op
 
 
 def _pref():
@@ -28,7 +28,220 @@ def _pair(v):
     return (v, v)
 
 
-@register_op("conv2d", inputs=("Input", "Filter"), outputs=("Output",))
+# ---------------------------------------------------------------------------
+# infer_shape rules (registry-audit ratchet: conv/pool family).  Same
+# contract as the elementwise/matmul rules in math_ops.py: backfill
+# missing output metadata, SkipInferShape when statically unknowable,
+# ValueError only for shapes the lowering would also reject.
+# ---------------------------------------------------------------------------
+
+
+def _io_vars(op, block, in_slot, out_slot):
+    ins = op.inputs.get(in_slot, [])
+    outs = op.outputs.get(out_slot, [])
+    if len(ins) != 1 or len(outs) != 1 or not ins[0] or not outs[0]:
+        raise SkipInferShape
+    xv = block.find_var(ins[0])
+    ov = block.find_var(outs[0])
+    if xv is None or ov is None or xv.shape is None:
+        raise SkipInferShape
+    return xv, ov
+
+
+def _conv_extent(size, k, p, s, d=1):
+    if size < 0:
+        return -1
+    out = (size + 2 * p - ((k - 1) * d + 1)) // s + 1
+    if out < 1:
+        raise ValueError(
+            f"conv/pool output extent {out} < 1 (input {size}, kernel {k}, "
+            f"pad {p}, stride {s}, dilation {d})")
+    return out
+
+
+def _nd(op, name, default, n):
+    v = op.attr(name, default)
+    v = tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
+    if len(v) != n:
+        raise SkipInferShape
+    return v
+
+
+def _make_conv_infer(spatial: int, transpose: bool = False):
+    def infer(op, block):
+        xv, ov = _io_vars(op, block, "Input", "Output")
+        fs = op.inputs.get("Filter", [])
+        wv = block.find_var(fs[0]) if len(fs) == 1 and fs[0] else None
+        if (wv is None or wv.shape is None or ov.shape is not None
+                or len(xv.shape) != 2 + spatial
+                or len(wv.shape) != 2 + spatial):
+            if ov.shape is not None:
+                return
+            raise SkipInferShape
+        ones = (1,) * spatial
+        zeros = (0,) * spatial
+        strides = _nd(op, "strides", ones, spatial)
+        pads = _nd(op, "paddings", zeros, spatial)
+        dils = _nd(op, "dilations", ones, spatial)
+        if transpose:
+            # filter (Cin, Cout, *k).  Match what lax.conv_transpose
+            # with transpose_kernel=True actually emits:
+            # (in-1)*s + 2p - (k-1)*d + 1 (verified empirically across
+            # stride/pad/dilation combos).  NB the layer builder stamps
+            # the Paddle-paper convention ((in-1)*s - 2p + (k-1)*d + 1)
+            # at build time — the two agree exactly when
+            # p == (k-1)*d/2 (every shipped config); this rule only
+            # backfills missing metadata, so built programs keep the
+            # builder's value.
+            out_c = wv.shape[1]
+
+            def _t_extent(i):
+                size = xv.shape[2 + i]
+                if size < 0:
+                    return -1
+                out = (size - 1) * strides[i] + 2 * pads[i] \
+                    - (wv.shape[2 + i] - 1) * dils[i] + 1
+                if out < 1:
+                    raise ValueError(
+                        f"conv_transpose output extent {out} < 1 "
+                        f"(input {size}, kernel {wv.shape[2 + i]}, "
+                        f"pad {pads[i]}, stride {strides[i]}, "
+                        f"dilation {dils[i]})")
+                return out
+
+            sp = tuple(_t_extent(i) for i in range(spatial))
+        else:
+            out_c = wv.shape[0]
+            sp = tuple(_conv_extent(xv.shape[2 + i], wv.shape[2 + i],
+                                    pads[i], strides[i], dils[i])
+                       for i in range(spatial))
+        ov.shape = (xv.shape[0], out_c) + sp
+
+    return infer
+
+
+def _make_pool_infer(spatial: int, out_slot: str = "Out",
+                     default_strides=None, also: tuple = ()):
+    def infer(op, block):
+        xv, ov = _io_vars(op, block, "X", out_slot)
+        if len(xv.shape) != 2 + spatial:
+            raise SkipInferShape
+        if ov.shape is None:
+            if op.attr("global_pooling", False):
+                sp = (1,) * spatial
+            else:
+                ks = _nd(op, "ksize", (2,) * spatial, spatial)
+                st_default = (ks if default_strides == "ksize"
+                              else default_strides or (1,) * spatial)
+                st = _nd(op, "strides", st_default, spatial)
+                pd = _nd(op, "paddings", (0,) * spatial, spatial)
+                ceil = op.attr("ceil_mode", False)
+                sp = []
+                for i in range(spatial):
+                    size = xv.shape[2 + i]
+                    if size < 0:
+                        sp.append(-1)
+                        continue
+                    from paddle_tpu.layers.nn import pool_out_extent
+
+                    sp.append(pool_out_extent(size, ks[i], pd[i], st[i],
+                                              ceil_mode=ceil))
+                sp = tuple(sp)
+            ov.shape = tuple(xv.shape[:2]) + sp
+        for slot in also:   # e.g. the with_index Mask mirrors Out
+            extra = op.outputs.get(slot, [])
+            if len(extra) == 1 and extra[0]:
+                ev = block.find_var(extra[0])
+                if ev is not None and ev.shape is None:
+                    ev.shape = tuple(ov.shape)
+
+    return infer
+
+
+def _infer_mirror_x(*out_slots, in_slot="X"):
+    """Every named output mirrors the (single) ``in_slot`` input."""
+
+    def infer(op, block):
+        ins = op.inputs.get(in_slot, [])
+        if len(ins) != 1 or not ins[0]:
+            raise SkipInferShape
+        xv = block.find_var(ins[0])
+        if xv is None or xv.shape is None:
+            raise SkipInferShape
+        hit = False
+        for slot in out_slots:
+            outs = op.outputs.get(slot, [])
+            if len(outs) != 1 or not outs[0]:
+                continue
+            ov = block.find_var(outs[0])
+            if ov is None:
+                continue
+            hit = True
+            if ov.shape is None:
+                ov.shape = tuple(xv.shape)
+            if ov.lod_level == 0 and xv.lod_level:
+                ov.lod_level = xv.lod_level
+        if not hit:
+            raise SkipInferShape
+
+    return infer
+
+
+def _infer_batch_norm_shape(op, block):
+    xv, ov = _io_vars(op, block, "X", "Y")
+    if ov.shape is None:
+        ov.shape = tuple(xv.shape)
+    if len(xv.shape) < 2:
+        return
+    c = xv.shape[1]
+    if c < 0:
+        return
+    for slot in ("MeanOut", "VarianceOut", "SavedMean", "SavedVariance"):
+        outs = op.outputs.get(slot, [])
+        if len(outs) == 1 and outs[0]:
+            sv = block.find_var(outs[0])
+            if sv is not None and sv.shape is None:
+                sv.shape = (c,)
+
+
+def _infer_maxout_shape(op, block):
+    xv, ov = _io_vars(op, block, "X", "Out")
+    if ov.shape is not None or len(xv.shape) != 4:
+        raise SkipInferShape
+    groups = op.attr("groups", None)
+    if not groups:
+        raise SkipInferShape
+    n, c, h, w = xv.shape
+    if c >= 0 and c % groups != 0:
+        raise ValueError(f"maxout: channels {c} not divisible by "
+                         f"groups {groups}")
+    ov.shape = (n, c // groups if c >= 0 else -1, h, w)
+
+
+def _infer_pad_shape(op, block):
+    xv, ov = _io_vars(op, block, "X", "Out")
+    if ov.shape is not None:
+        return
+    paddings = op.attr("paddings", None)
+    if not paddings or len(paddings) != 2 * len(xv.shape):
+        raise SkipInferShape
+    ov.shape = tuple(
+        -1 if d < 0 else d + paddings[2 * i] + paddings[2 * i + 1]
+        for i, d in enumerate(xv.shape))
+
+
+def _infer_bilinear_shape(op, block):
+    xv, ov = _io_vars(op, block, "X", "Out")
+    if ov.shape is not None or len(xv.shape) != 4:
+        raise SkipInferShape
+    oh, ow = op.attr("out_h", None), op.attr("out_w", None)
+    if not oh or not ow:
+        raise SkipInferShape
+    ov.shape = (xv.shape[0], xv.shape[1], int(oh), int(ow))
+
+
+@register_op("conv2d", inputs=("Input", "Filter"), outputs=("Output",),
+             infer_shape=_make_conv_infer(2))
 def _conv2d(ctx):
     """NCHW conv, filter (O, I/groups, H, W), groups supported
     (reference: operators/conv_op.cc)."""
@@ -70,7 +283,8 @@ def _conv2d(ctx):
     ctx.set_output("Output", out)
 
 
-@register_op("conv3d", inputs=("Input", "Filter"), outputs=("Output",))
+@register_op("conv3d", inputs=("Input", "Filter"), outputs=("Output",),
+             infer_shape=_make_conv_infer(3))
 def _conv3d(ctx):
     x = unwrap(ctx.input("Input"))
     w = unwrap(ctx.input("Filter"))
@@ -90,7 +304,9 @@ def _conv3d(ctx):
     ctx.set_output("Output", out)
 
 
-@register_op("conv2d_transpose", inputs=("Input", "Filter"), outputs=("Output",))
+@register_op("conv2d_transpose", inputs=("Input", "Filter"),
+             outputs=("Output",),
+             infer_shape=_make_conv_infer(2, transpose=True))
 def _conv2d_transpose(ctx):
     """Gradient-of-conv as a forward op (reference:
     operators/conv_transpose_op.cc).  Filter layout (I, O, H, W)."""
@@ -115,7 +331,7 @@ def _conv2d_transpose(ctx):
     ctx.set_output("Output", out)
 
 
-@register_op("pool2d", inputs=("X",))
+@register_op("pool2d", inputs=("X",), infer_shape=_make_pool_infer(2))
 def _pool2d(ctx):
     x = unwrap(ctx.input("X"))
     ptype = ctx.attr("pooling_type", "max")
@@ -185,7 +401,8 @@ def _pool2d(ctx):
 @register_op("batch_norm",
              inputs=("X", "Scale", "Bias", "Mean", "Variance", "Length"),
              outputs=("Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"),
-             diff_inputs=("X", "Scale", "Bias"))
+             diff_inputs=("X", "Scale", "Bias"),
+             infer_shape=_infer_batch_norm_shape)
 def _batch_norm(ctx):
     """Training/inference BN over NCHW channel axis 1 (reference:
     operators/batch_norm_op.cc).  MeanOut/VarianceOut are the running
@@ -280,6 +497,7 @@ def _dropout_grad_lower(ctx):
 
 
 @register_op("dropout", inputs=("X",), outputs=("Out", "Mask"),
+             infer_shape=_infer_mirror_x("Out", "Mask"),
              grad_lower=_dropout_grad_lower)
 def _dropout(ctx):
     x = ctx.input("X")
@@ -309,7 +527,8 @@ def _softmax(ctx):
     ctx.set_output("Out", rewrap(unary_in, jax.nn.softmax(x, axis=-1)))
 
 
-@register_op("lrn", inputs=("X",), outputs=("Out", "MidOut"))
+@register_op("lrn", inputs=("X",), outputs=("Out", "MidOut"),
+             infer_shape=_infer_mirror_x("Out", "MidOut"))
 def _lrn(ctx):
     """Local response norm across channels (reference: operators/lrn_op.cc)."""
     x = unwrap(ctx.input("X"))
@@ -326,7 +545,7 @@ def _lrn(ctx):
     ctx.set_output("Out", (x / jnp.power(mid, beta)).astype(x.dtype))
 
 
-@register_op("maxout", inputs=("X",))
+@register_op("maxout", inputs=("X",), infer_shape=_infer_maxout_shape)
 def _maxout(ctx):
     x = unwrap(ctx.input("X"))
     groups = ctx.attr("groups")
@@ -334,7 +553,7 @@ def _maxout(ctx):
     ctx.set_output("Out", jnp.max(x.reshape(n, c // groups, groups, h, w), axis=2))
 
 
-@register_op("pad", inputs=("X",))
+@register_op("pad", inputs=("X",), infer_shape=_infer_pad_shape)
 def _pad(ctx):
     x = unwrap(ctx.input("X"))
     paddings = ctx.attr("paddings")
@@ -376,7 +595,8 @@ def _crop(ctx):
 
 
 @register_op("conv3d_transpose", inputs=("Input", "Filter"),
-             outputs=("Output",))
+             outputs=("Output",),
+             infer_shape=_make_conv_infer(3, transpose=True))
 def _conv3d_transpose(ctx):
     """3-D transposed conv (reference: operators/conv_transpose_op.cc
     3-D registration).  Filter layout (I, O, D, H, W)."""
@@ -396,7 +616,8 @@ def _conv3d_transpose(ctx):
     ctx.set_output("Output", out)
 
 
-@register_op("bilinear_interp", inputs=("X",))
+@register_op("bilinear_interp", inputs=("X",),
+             infer_shape=_infer_bilinear_shape)
 def _bilinear_interp(ctx):
     """Bilinear resize over NCHW spatial dims (reference:
     operators/bilinear_interp_op.cc / BilinearInterpLayer)."""

@@ -18,7 +18,83 @@ import jax.numpy as jnp
 from jax import lax
 
 from paddle_tpu.lod import LoDArray, rewrap, row_segment_ids, unwrap
-from paddle_tpu.registry import register_op
+from paddle_tpu.registry import SkipInferShape, infer_same_shape, register_op
+
+
+# ---------------------------------------------------------------------------
+# infer_shape rules (registry-audit ratchet: padded-sequence family).
+# The padded ops are plain dense tensors + length side-feeds, so their
+# shapes are statically knowable; the LoD ops (packed rows + offsets)
+# stay dynamic and keep SkipInferShape semantics via omission.
+# ---------------------------------------------------------------------------
+
+
+def _seq_io_vars(op, block):
+    # the one slot-resolution contract, shared with the conv/pool rules
+    from paddle_tpu.ops.nn_ops import _io_vars
+
+    return _io_vars(op, block, "X", "Out")
+
+
+def _infer_drop_time_shape(op, block):
+    """Pooling over the padded time dim: (B, T, ...) -> (B, ...)."""
+    xv, ov = _seq_io_vars(op, block)
+    if len(xv.shape) < 2:
+        raise SkipInferShape
+    if ov.shape is None:
+        ov.shape = (xv.shape[0],) + tuple(xv.shape[2:])
+
+
+def _infer_drop_subseq_time_shape(op, block):
+    """Nested pooling: (B, S, T, ...) -> (B, S, ...)."""
+    xv, ov = _seq_io_vars(op, block)
+    if len(xv.shape) < 3:
+        raise SkipInferShape
+    if ov.shape is None:
+        ov.shape = tuple(xv.shape[:2]) + tuple(xv.shape[3:])
+
+
+def _infer_stride_pool_shape(op, block):
+    xv, ov = _seq_io_vars(op, block)
+    if len(xv.shape) < 2:
+        raise SkipInferShape
+    stride = op.attr("stride", None)
+    if not stride:
+        raise SkipInferShape
+    t = xv.shape[1]
+    if ov.shape is None:
+        w = -(-t // int(stride)) if t >= 0 else -1
+        ov.shape = (xv.shape[0], w) + tuple(xv.shape[2:])
+    outs = op.outputs.get("OutLength", [])
+    if len(outs) == 1 and outs[0]:
+        lv = block.find_var(outs[0])
+        if lv is not None and lv.shape is None:
+            lv.shape = (xv.shape[0],)
+
+
+def _infer_subseq_mask_flatten_shape(op, block):
+    """mask_padded_subseq_scores: (B, S, T[, 1]) -> (B, S*T)."""
+    xv, ov = _seq_io_vars(op, block)
+    shape = tuple(xv.shape)
+    if len(shape) == 4 and shape[-1] == 1:
+        shape = shape[:-1]
+    if len(shape) != 3:
+        raise SkipInferShape
+    if ov.shape is None:
+        b, s, t = shape
+        ov.shape = (b, s * t if s >= 0 and t >= 0 else -1)
+
+
+def _infer_context_project_shape(op, block):
+    xv, ov = _seq_io_vars(op, block)
+    if ov.shape is not None:
+        return
+    ctx_len = op.attr("context_length", None)
+    if not ctx_len or len(xv.shape) < 2:
+        raise SkipInferShape
+    last = xv.shape[-1]
+    ov.shape = tuple(xv.shape[:-1]) + (
+        last * int(ctx_len) if last >= 0 else -1,)
 
 
 def _seg_ids(x: LoDArray):
@@ -59,7 +135,8 @@ def _sequence_pool(ctx):
     ctx.set_output("Out", out)
 
 
-@register_op("sequence_softmax", inputs=("X",))
+@register_op("sequence_softmax", inputs=("X",),
+             infer_shape=infer_same_shape)
 def _sequence_softmax(ctx):
     x = ctx.input("X")
     assert isinstance(x, LoDArray)
@@ -159,7 +236,8 @@ def _seq_expand(ctx):
     ctx.set_output("Out", LoDArray(out, y.lod))
 
 
-@register_op("lod_reset", inputs=("X", "TargetLoD"))
+@register_op("lod_reset", inputs=("X", "TargetLoD"),
+             infer_shape=infer_same_shape)
 def _lod_reset(ctx):
     x = ctx.input("X")
     data = unwrap(x)
@@ -170,7 +248,8 @@ def _lod_reset(ctx):
     ctx.set_output("Out", LoDArray(data, (target,)))
 
 
-@register_op("padded_sequence_pool", inputs=("X", "Length"))
+@register_op("padded_sequence_pool", inputs=("X", "Length"),
+             infer_shape=_infer_drop_time_shape)
 def _padded_sequence_pool(ctx):
     """Masked pooling over padded (B, T, D) sequences with lengths (B,)
     — the dense-layout twin of sequence_pool for the v2 facade."""
@@ -210,7 +289,8 @@ def _masked_pool(x, mask, ptype, axis):
 
 
 @register_op("padded_subseq_pool", inputs=("X", "Length", "SubLength"),
-             diff_inputs=("X",))
+             diff_inputs=("X",),
+             infer_shape=_infer_drop_subseq_time_shape)
 def _padded_subseq_pool(ctx):
     """Pooling over a padded 2-level nested sequence (reference:
     gserver/layers/SequencePoolLayer.cpp with trans_type="seq"/"non-seq"
@@ -339,7 +419,8 @@ def _padded_subseq_slice(ctx):
 
 
 @register_op("padded_sequence_stride_pool", inputs=("X", "Length"),
-             outputs=("Out", "OutLength"), diff_inputs=("X",))
+             outputs=("Out", "OutLength"), diff_inputs=("X",),
+             infer_shape=_infer_stride_pool_shape)
 def _padded_sequence_stride_pool(ctx):
     """Strided sequence pooling (reference: SequencePoolLayer stride_ —
     pool each window of ``stride`` steps; output is a shorter sequence
@@ -361,7 +442,7 @@ def _padded_sequence_stride_pool(ctx):
 
 
 @register_op("padded_sequence_max_index", inputs=("X", "Length"),
-             stop_gradient=True)
+             stop_gradient=True, infer_shape=_infer_drop_time_shape)
 def _padded_sequence_max_index(ctx):
     """Max pooling returning the argmax step index per feature
     (reference: MaxPoolingType(output_max_index=True),
@@ -651,7 +732,8 @@ def _expand_to_subseq(ctx):
     ctx.set_output("Out", out)
 
 
-@register_op("context_project", inputs=("X", "Length"))
+@register_op("context_project", inputs=("X", "Length"),
+             infer_shape=_infer_context_project_shape)
 def _context_project(ctx):
     """Sliding-window concat over time (reference: function/
     ContextProjectionOp.cpp; v1 context_projection).  X (B, T, D) ->
@@ -687,7 +769,7 @@ def _context_project(ctx):
 
 
 @register_op("padded_sequence_softmax", inputs=("X", "Length"),
-             diff_inputs=("X",))
+             diff_inputs=("X",), infer_shape=infer_same_shape)
 def _padded_sequence_softmax(ctx):
     """Softmax over the time dim of a padded (B, T) or (B, T, 1) score
     tensor, masking steps >= Length (the padded-batch analog of the
@@ -756,7 +838,9 @@ def _sub_nested_seq(ctx):
     ctx.set_output("OutSubLengths", out_sub)
 
 
-@register_op("mask_padded_subseq_scores", inputs=("X", "Length", "SubLength"))
+@register_op("mask_padded_subseq_scores",
+             inputs=("X", "Length", "SubLength"),
+             infer_shape=_infer_subseq_mask_flatten_shape)
 def _mask_padded_subseq_scores(ctx):
     """Mask a padded nested score tensor (B, S, T) to -1e9 on padding
     (rows past Length, inner steps past SubLength) and flatten to
@@ -776,7 +860,8 @@ def _mask_padded_subseq_scores(ctx):
     ctx.set_output("Out", out.reshape(B, S * T))
 
 
-@register_op("mask_padded_scores", inputs=("X", "Length"))
+@register_op("mask_padded_scores", inputs=("X", "Length"),
+             infer_shape=infer_same_shape)
 def _mask_padded_scores(ctx):
     """Set scores past each sequence's length to -inf so top-k/argmax
     never select padding steps (KmaxSeqScoreLayer's per-sequence
@@ -789,7 +874,8 @@ def _mask_padded_scores(ctx):
     ctx.set_output("Out", jnp.where(mask, x, jnp.asarray(-1e30, x.dtype)))
 
 
-@register_op("padded_sequence_reverse", inputs=("X", "Length"))
+@register_op("padded_sequence_reverse", inputs=("X", "Length"),
+             infer_shape=infer_same_shape)
 def _padded_sequence_reverse(ctx):
     """Reverse each row of a padded (B, T, ...) tensor inside its valid
     window (reference: the LoD reverse semantics of reversed recurrent

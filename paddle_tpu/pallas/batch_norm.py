@@ -31,6 +31,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from paddle_tpu.pallas import compat as _compat
+
 _F32 = jnp.float32
 
 
@@ -117,7 +119,7 @@ def _bn_fwd_impl(x2d, gamma, beta, eps: float, interpret: bool = False):
             jax.ShapeDtypeStruct((1, C), _F32),
         ],
         scratch_shapes=[pltpu.VMEM((2, C), _F32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compat.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(x2d, gamma.reshape(1, C), beta.reshape(1, C))
@@ -191,7 +193,7 @@ def _bn_bwd_impl(x2d, dy2d, gamma, mean, inv, interpret: bool = False):
             jax.ShapeDtypeStruct((1, C), _F32),
         ],
         scratch_shapes=[pltpu.VMEM((2, C), _F32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compat.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
     )(x2d, dy2d, gamma.reshape(1, C), mean.reshape(1, C), inv.reshape(1, C))

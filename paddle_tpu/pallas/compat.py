@@ -1,0 +1,13 @@
+"""jax version compat for the pallas kernels (the pltpu analog of
+parallel/compat.py): the kernels target the modern
+``pltpu.CompilerParams`` name, which jax < 0.4.38 spells
+``TPUCompilerParams`` (same dataclass).  Resolved here, in OUR
+namespace — monkeypatching the jax module would leak the new-API name
+into every other library's feature detection."""
+
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as _pltpu
+
+CompilerParams = getattr(_pltpu, "CompilerParams",
+                         getattr(_pltpu, "TPUCompilerParams", None))

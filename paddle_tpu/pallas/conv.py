@@ -35,6 +35,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from paddle_tpu.pallas import compat as _compat
+
 _VMEM_BUDGET = 9 * 1024 * 1024
 
 
@@ -181,7 +183,7 @@ def _conv_fwd_impl(x, w, padding: int, interpret: bool = False,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=scratch,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compat.CompilerParams(
             dimension_semantics=semantics),
         interpret=interpret,
     )(xp, w)
@@ -237,7 +239,7 @@ def _conv_dw_impl(x, g, kernel: int, padding: int, interpret: bool = False):
         out_specs=pl.BlockSpec((1, kw, c, o), lambda k, b, r: (k, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((kh, kw, c, o), jnp.float32),
         scratch_shapes=[pltpu.VMEM((kw * c, o), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compat.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
         interpret=interpret,
     )(xp, g)

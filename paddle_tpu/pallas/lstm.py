@@ -29,6 +29,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from paddle_tpu.pallas import compat as _compat
+
 
 def _lstm_kernel(xp_ref, w_ref, b_ref, h0_ref, c0_ref,
                  hs_ref, cs_ref, gates_ref, h_s, c_s):
@@ -91,7 +93,7 @@ def _lstm_seq_impl(xproj, w, bias, h0, c0, interpret: bool = False):
         ],
         scratch_shapes=[pltpu.VMEM((B, H), jnp.float32),
                         pltpu.VMEM((B, H), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compat.CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(xproj, w, bias.reshape(1, H4), h0, c0)

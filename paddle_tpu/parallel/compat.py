@@ -1,0 +1,18 @@
+"""jax version compat for the parallel package."""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(fn, *, mesh, in_specs, out_specs):
+    """``jax.shard_map(..., check_vma=False)`` with fallback to the
+    pre-0.4.38 spelling (``jax.experimental.shard_map.shard_map`` with
+    ``check_rep``)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm  # pragma: no cover
+
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)

@@ -74,7 +74,8 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     kernel's causal mask, earlier chunks run unmasked.  Shapes the
     kernel rejects fall back to the jnp online-softmax block.
     """
-    n = lax.axis_size(axis_name)
+    n = (lax.axis_size(axis_name) if hasattr(lax, "axis_size")
+         else lax.psum(1, axis_name))  # pre-0.4.38 spelling
     idx = lax.axis_index(axis_name)
     B, H, S, D = q.shape
     if scale is None:
@@ -202,7 +203,8 @@ def ring_attention_sharded(mesh, sp_axis: str, q, k, v,
     """
     spec = P(batch_axis, head_axis, sp_axis, None)
     fn = functools.partial(ring_attention, axis_name=sp_axis, causal=causal)
-    mapped = jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+    from paddle_tpu.parallel.compat import shard_map as _shard_map
+
+    mapped = _shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                        out_specs=spec)
     return mapped(q, k, v)

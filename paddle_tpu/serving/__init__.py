@@ -21,7 +21,7 @@ single executor behind a lock:
 
 Endpoints:
   GET  /health           → {"status": "ok", "feeds": [...], "fetches":
-                           [...], "batching": {...}}
+                           [...], "batching": {...}, "generation": {...}}
   GET  /metrics          → Prometheus text exposition (0.0.4): request
                            latency histogram, in-flight gauge, status
                            counters, serving_batch_size /
@@ -33,6 +33,19 @@ Endpoints:
                            → {"outputs": [nested-list per fetch]}
                            Unknown payload keys (other than ``@len``
                            side-feeds) are a 400 naming the key.
+  POST /generate         → body {"src": [int ids], "max_new_tokens": N,
+                           "stream": bool} against a paged-KV decode
+                           engine (paddle_tpu/decode).  With
+                           ``stream`` (default true) the reply is
+                           chunked ndjson — one ``{"token": t}`` line
+                           per generated token as the continuous-
+                           batching session emits it, then a final
+                           ``{"done": true, "ids": [...],
+                           "finish_reason": ...}`` line; without it,
+                           one JSON object after generation finishes.
+                           Page-pool exhaustion / full admission queue
+                           → 503 (admission refusal, live sequences
+                           unaffected); request deadline → 504.
 
 Graceful degradation (bounded, not unbounded thread pileup):
   - ``max_inflight``: admission cap — requests beyond it are rejected
@@ -53,9 +66,11 @@ Launch:  paddle serve --model_dir=DIR [--port=N]
 from __future__ import annotations
 
 import json
+import queue as queue_mod
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
 
 import numpy as np
 
@@ -101,30 +116,40 @@ def _jsonable(o):
 
 
 class InferenceServer:
-    def __init__(self, model_dir: str, port: int = 0,
+    def __init__(self, model_dir: Optional[str], port: int = 0,
                  request_timeout: float = None, max_inflight: int = None,
                  replicas: int = 1, max_batch: int = 8,
                  batch_timeout_ms: float = 0.0, warmup: bool = False,
-                 place=None):
-        self._bundle = ModelBundle(model_dir)
-        self.feed_names = self._bundle.feed_names
-        self._fetches = self._bundle.fetch_names
+                 generator=None, place=None):
+        if model_dir is None and generator is None:
+            raise ValueError("need a model_dir to predict from and/or a "
+                             "generator (paddle_tpu.decode."
+                             "GenerationEngine) to generate with")
+        self._generator = generator
+        self._bundle = ModelBundle(model_dir) if model_dir else None
+        self.feed_names = (self._bundle.feed_names if self._bundle else [])
+        self._fetches = (self._bundle.fetch_names if self._bundle else [])
         self._feed_set = frozenset(self.feed_names)
-        if max_batch > 1:
+        if self._bundle is None:
+            self._spec = BatchSpec.disabled(
+                "generation-only server (no --model_dir export loaded)",
+                code="generation_only")
+        elif max_batch > 1:
             self._spec = self._bundle.batch_spec()
         else:
             self._spec = BatchSpec.disabled(
                 "coalescing off (max_batch <= 1): every request runs at "
-                "its exact feed shape")
+                "its exact feed shape", code="coalescing_off")
         self._queue = RequestQueue(max_batch=max_batch,
                                    batch_timeout=batch_timeout_ms / 1000.0)
-        self._pool = ReplicaPool(self._bundle, self._queue, self._spec,
-                                 replicas=replicas, place=place)
+        self._pool = (ReplicaPool(self._bundle, self._queue, self._spec,
+                                  replicas=replicas, place=place)
+                      if self._bundle else None)
         self._request_timeout = request_timeout
         self._max_inflight = max_inflight
         self._slots = (threading.BoundedSemaphore(max_inflight)
                        if max_inflight else None)
-        if warmup:
+        if warmup and self._pool is not None:
             self._pool.warmup()
 
         server = self
@@ -160,7 +185,9 @@ class InferenceServer:
                         "feeds": server.feed_names,
                         "fetches": [getattr(f, "name", str(f))
                                     for f in server._fetches],
-                        "batching": server.batching_info()})
+                        "batching": server.batching_info(),
+                        "generation": (server._generator.info()
+                                       if server._generator else None)})
                 elif self.path == "/metrics":
                     self._reply(
                         200, None,
@@ -183,6 +210,9 @@ class InferenceServer:
                 except (BrokenPipeError, ConnectionResetError):
                     _M_REJECTED.inc(reason="client_gone")
                     self.close_connection = True
+                    return
+                if self.path == "/generate":
+                    self._handle_generate(raw_body)
                     return
                 if self.path != "/predict":
                     self._reply(404, {"error": "unknown path"})
@@ -224,6 +254,127 @@ class InferenceServer:
                     _EVENTS.complete("serving.predict", ev_t0, dt,
                                      cat="serving")
 
+            # -- generation (paged-KV decode engine) ---------------------
+
+            def _chunk(self, obj) -> None:
+                data = json.dumps(obj).encode() + b"\n"
+                self.wfile.write(f"{len(data):X}\r\n".encode()
+                                 + data + b"\r\n")
+
+            def _handle_generate(self, raw_body: bytes) -> None:
+                from paddle_tpu.decode import AdmissionRefused
+
+                if server._generator is None:
+                    self._reply(400, {"error": "no generation engine "
+                                      "mounted (serve with --gen_config)"})
+                    return
+                _M_INFLIGHT.inc()
+                ev_t0 = _EVENTS.now()
+                t0 = time.perf_counter()
+                try:
+                    payload = json.loads(raw_body or b"{}")
+                    if not isinstance(payload, dict):
+                        raise ValueError(
+                            "request body must be a JSON object")
+                    src = payload.get("src")
+                    if (not isinstance(src, list) or not src
+                            or not all(isinstance(t, int) for t in src)):
+                        raise ValueError(
+                            "'src' must be a non-empty list of int ids")
+                    unknown = set(payload) - {"src", "max_new_tokens",
+                                              "stream"}
+                    if unknown:
+                        raise ValueError(
+                            f"unknown payload key {sorted(unknown)[0]!r}; "
+                            "expected src / max_new_tokens / stream")
+                    budget = payload.get("max_new_tokens")
+                    deadline = (time.monotonic() + server._request_timeout
+                                if server._request_timeout else None)
+                    if payload.get("stream", True):
+                        self._stream_generate(src, budget, deadline)
+                    else:
+                        req = server._generator.submit(src, budget,
+                                                       deadline=deadline)
+                        # grace past the deadline: the session itself
+                        # expires the request and reports it
+                        timeout = (None if deadline is None else
+                                   max(0.0, deadline - time.monotonic())
+                                   + 30.0)
+                        ids = req.result(timeout)
+                        self._reply(200, {
+                            "ids": ids,
+                            "finish_reason": req.finish_reason})
+                except AdmissionRefused as e:
+                    _M_REJECTED.inc(reason=e.reason)
+                    self._reply(503, {"error": str(e),
+                                      "reason": e.reason})
+                except TimeoutError as e:
+                    _M_REJECTED.inc(reason="deadline")
+                    self._reply(504, {"error": str(e)})
+                except (BrokenPipeError, ConnectionResetError):
+                    _M_REJECTED.inc(reason="client_gone")
+                    self.close_connection = True
+                except (KeyError, ValueError, TypeError) as e:
+                    self._reply(400, {"error": str(e)})
+                except Exception as e:
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                finally:
+                    dt = time.perf_counter() - t0
+                    _M_INFLIGHT.dec()
+                    _M_REQ_SEC.observe(dt, endpoint="/generate")
+                    _EVENTS.complete("serving.generate", ev_t0, dt,
+                                     cat="serving")
+
+            def _stream_generate(self, src, budget, deadline) -> None:
+                """Chunked ndjson: one line per token as the decode
+                session emits it, then the summary line.  Admission
+                refusals (503) and pre-stream deadline expiry (504)
+                raise BEFORE any header is written; once tokens are
+                flowing, a mid-stream expiry rides the final line as
+                ``finish_reason: "deadline"`` (the status is already
+                on the wire)."""
+                q: queue_mod.Queue = queue_mod.Queue()
+                req = server._generator.submit(src, budget,
+                                               on_token=q.put,
+                                               deadline=deadline)
+                if deadline is not None:
+                    # hold the 200 until the stream actually starts:
+                    # a request that dies of its deadline before its
+                    # first token must be the documented 504, not a
+                    # 200 that trickles out an error line
+                    while (req.first_token_at is None
+                           and not req.wait(0.01)):
+                        pass
+                    if req.first_token_at is None and req.done:
+                        if isinstance(req.error, TimeoutError):
+                            raise req.error
+                        if req.error is not None:
+                            raise req.error
+                _M_RESPONSES.inc(code="200")
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/x-ndjson")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    while True:
+                        try:
+                            self._chunk({"token": q.get(timeout=0.05)})
+                        except queue_mod.Empty:
+                            if req.done and q.empty():
+                                break
+                    final = {"done": True, "ids": req.tokens,
+                             "finish_reason": req.finish_reason}
+                    if req.error is not None:
+                        final["error"] = str(req.error)
+                    self._chunk(final)
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    # the consumer left; the session still finishes the
+                    # sequence (its slot frees naturally) — count it
+                    _M_REJECTED.inc(reason="client_gone")
+                    self.close_connection = True
+
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
@@ -244,7 +395,7 @@ class InferenceServer:
         return {
             "enabled": self._spec.batchable,
             "reason": self._spec.reason,
-            "replicas": len(self._pool.replicas),
+            "replicas": len(self._pool.replicas) if self._pool else 0,
             "max_batch": self._queue.max_batch,
             "batch_timeout_ms": self._queue.batch_timeout * 1000.0,
             "buckets": (list(bucket_ladder(self._queue.max_batch))
@@ -278,11 +429,19 @@ class InferenceServer:
         (a ``time.monotonic`` timestamp) bounds the *whole* wait —
         queueing and execution; an expired request raises TimeoutError
         (504 over HTTP) instead of stacking up behind busy replicas."""
+        if self._bundle is None:
+            raise ValueError("this server mounts no inference export "
+                             "(generation-only; POST /generate instead)")
         feed = self._build_feeds(payload)
         info = self._spec.classify(feed)
         if info is None:
+            # model-level unbatchability carries the BatchSpec code;
+            # a batchable model whose request shapes didn't line up is
+            # a per-request miss
+            reason = (self._spec.code if not self._spec.batchable
+                      else "shape_mismatch")
             req = PendingRequest(feed, rows=1, batchable=False,
-                                 deadline=deadline)
+                                 deadline=deadline, solo_reason=reason)
         else:
             rows, cast = info
             req = PendingRequest(cast, rows=rows, batchable=True,
@@ -303,17 +462,22 @@ class InferenceServer:
 
     def warmup(self):
         """Pre-compile the bucket ladder on every replica."""
-        return self._pool.warmup()
+        return self._pool.warmup() if self._pool else 0
 
     def pause(self):
         """Stop replicas taking new batches (drain/maintenance); queued
         requests wait (and expire against their deadlines)."""
-        self._pool.pause()
+        if self._pool:
+            self._pool.pause()
 
     def resume(self):
-        self._pool.resume()
+        if self._pool:
+            self._pool.resume()
 
     def stop(self):
         self._httpd.shutdown()
-        self._pool.stop()
+        if self._pool:
+            self._pool.stop()
+        if self._generator is not None:
+            self._generator.stop()
         self._httpd.server_close()

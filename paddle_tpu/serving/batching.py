@@ -53,6 +53,12 @@ _M_BATCH_ROWS = _metrics.histogram(
     "coalesced request rows per executed batch "
     "(label bucket = padded rows dispatched, 'unbatched' = solo path)",
     buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0))
+_M_UNBATCHED = _metrics.counter(
+    "serving_unbatched_total",
+    "solo-fallback dispatches by reason (the BatchSpec disabled() "
+    "family: lod_feed/lod_fetch/not_batch_major/... when the model "
+    "cannot batch at all, shape_mismatch when this request's shapes "
+    "did not fit an otherwise batchable model)")
 
 
 def next_bucket(rows: int) -> int:
@@ -101,17 +107,21 @@ class BatchSpec:
     def __init__(self, batchable: bool, reason: str,
                  feed_names: Sequence[str] = (),
                  row_shapes: Optional[Dict[str, tuple]] = None,
-                 dtypes: Optional[Dict[str, Any]] = None):
+                 dtypes: Optional[Dict[str, Any]] = None,
+                 code: str = "ok"):
         self.batchable = batchable
         self.reason = reason
+        # short slug of the disabled() reason family — the label value
+        # for serving_unbatched_total (full prose stays in .reason)
+        self.code = code
         self.feed_names = tuple(feed_names)
         self.row_shapes = row_shapes or {}
         self.dtypes = dtypes or {}
         self._feed_set = frozenset(self.feed_names)
 
     @classmethod
-    def disabled(cls, reason: str) -> "BatchSpec":
-        return cls(False, reason)
+    def disabled(cls, reason: str, code: str = "disabled") -> "BatchSpec":
+        return cls(False, reason, code=code)
 
     @classmethod
     def from_program(cls, program, feed_names: Sequence[str],
@@ -123,17 +133,20 @@ class BatchSpec:
         for name in feed_names:
             var = block.find_var(name)
             if var is None or var.shape is None:
-                return cls.disabled(f"feed {name!r} has no shape metadata")
+                return cls.disabled(f"feed {name!r} has no shape metadata",
+                                    code="no_shape_metadata")
             if var.lod_level:
                 return cls.disabled(f"feed {name!r} is LoD "
-                                    f"(lod_level={var.lod_level})")
+                                    f"(lod_level={var.lod_level})",
+                                    code="lod_feed")
             if len(var.shape) < 1 or var.shape[0] != -1:
                 return cls.disabled(
-                    f"feed {name!r} shape {var.shape} is not batch-major")
+                    f"feed {name!r} shape {var.shape} is not batch-major",
+                    code="not_batch_major")
             if any(d < 0 for d in var.shape[1:]):
                 return cls.disabled(
                     f"feed {name!r} shape {var.shape} has dynamic "
-                    "non-batch dims")
+                    "non-batch dims", code="dynamic_dims")
             row_shapes[name] = tuple(var.shape[1:])
             from paddle_tpu.ops.common import jnp_dtype
 
@@ -141,14 +154,17 @@ class BatchSpec:
         for name in fetch_names:
             var = block.find_var(name)
             if var is None or var.shape is None:
-                return cls.disabled(f"fetch {name!r} has no shape metadata")
+                return cls.disabled(f"fetch {name!r} has no shape metadata",
+                                    code="no_shape_metadata")
             if var.lod_level:
                 return cls.disabled(f"fetch {name!r} is LoD "
-                                    f"(lod_level={var.lod_level})")
+                                    f"(lod_level={var.lod_level})",
+                                    code="lod_fetch")
             if len(var.shape) < 1 or var.shape[0] != -1:
                 return cls.disabled(
                     f"fetch {name!r} shape {var.shape} is not batch-major "
-                    "(per-request rows cannot be scattered back)")
+                    "(per-request rows cannot be scattered back)",
+                    code="not_batch_major")
         return cls(True, "ok", feed_names, row_shapes, dtypes)
 
     def classify(self, feeds: Dict[str, np.ndarray]):
@@ -180,14 +196,17 @@ class BatchSpec:
 class PendingRequest:
     """One in-flight request: feeds + row span + completion event."""
 
-    __slots__ = ("feeds", "rows", "batchable", "deadline", "enqueued_at",
-                 "abandoned", "outputs", "error", "bucket", "_event", "_done")
+    __slots__ = ("feeds", "rows", "batchable", "solo_reason", "deadline",
+                 "enqueued_at", "abandoned", "outputs", "error", "bucket",
+                 "_event", "_done")
 
     def __init__(self, feeds: Dict[str, Any], rows: int = 1,
-                 batchable: bool = False, deadline: Optional[float] = None):
+                 batchable: bool = False, deadline: Optional[float] = None,
+                 solo_reason: str = "unbatchable"):
         self.feeds = feeds
         self.rows = rows
         self.batchable = batchable
+        self.solo_reason = solo_reason    # serving_unbatched_total label
         self.deadline = deadline          # time.monotonic timestamp
         self.enqueued_at = time.monotonic()
         self.abandoned = False            # waiter gave up (timed out)

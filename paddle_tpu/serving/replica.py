@@ -28,6 +28,7 @@ from paddle_tpu.serving.batching import (
     PendingRequest,
     RequestQueue,
     _M_BATCH_ROWS,
+    _M_UNBATCHED,
     bucket_ladder,
     coalesce,
     scatter,
@@ -154,9 +155,13 @@ class ReplicaPool:
     def _execute(self, rep: Replica, batch: List[PendingRequest]) -> None:
         try:
             if len(batch) == 1 and not batch[0].batchable:
-                # legacy exact-shape path: ragged/LoD/odd-shaped request
+                # legacy exact-shape path: ragged/LoD/odd-shaped request.
+                # Counted by reason so the ragged-gap closure (paged
+                # decode taking these workloads) is measurable on
+                # /metrics before/after.
                 req = batch[0]
                 _M_BATCH_ROWS.observe(req.rows, bucket="unbatched")
+                _M_UNBATCHED.inc(reason=req.solo_reason)
                 req.complete(rep.run(req.feeds))
                 return
             feeds, rows, bucket = coalesce(batch, self.spec)

@@ -26,11 +26,12 @@ echo "== paddle stats: telemetry registry smoke"
 $PADDLE stats --json > /dev/null
 $PADDLE stats > /dev/null
 
-echo "== ruff: analysis + observability + distributed fault-tolerance + serving"
+echo "== ruff: analysis + observability + distributed fault-tolerance + serving + decode"
 if command -v ruff >/dev/null 2>&1; then
     ruff check paddle_tpu/analysis/ paddle_tpu/observability/ \
         paddle_tpu/distributed/elastic.py paddle_tpu/distributed/retry.py \
-        paddle_tpu/serving/ benchmark/serving_bench.py
+        paddle_tpu/serving/ paddle_tpu/decode/ \
+        benchmark/serving_bench.py benchmark/decode_bench.py
 else
     echo "ruff not installed; skipping style pass"
 fi
@@ -43,6 +44,17 @@ import json
 doc = json.load(open("/tmp/serving_bench_smoke.json"))
 assert doc["schema"] == "paddle_tpu.serving_bench.v1", doc["schema"]
 assert doc["configs"], "no bench configs recorded"
+EOF
+
+echo "== decode_bench: smoke (paged decode engine + artifact writer)"
+python benchmark/decode_bench.py --smoke --out /tmp/decode_bench_smoke.json \
+    > /dev/null
+python - <<'EOF'
+import json
+doc = json.load(open("/tmp/decode_bench_smoke.json"))
+assert doc["schema"] == "paddle_tpu.decode_bench.v1", doc["schema"]
+assert doc["tokens_identical"], "paged decode diverged from the solo oracle"
+assert doc["paged"]["cache"]["miss"] == 0, doc["paged"]["cache"]
 EOF
 
 echo "lint_self OK"

@@ -1,31 +1,50 @@
 #!/usr/bin/env bash
-# Sharded tier-1 runner (ROADMAP infra item b): the full `-m 'not slow'`
-# suite no longer fits one 600 s driver window, so split it into N
-# deterministic slices — each shard gets its own timeout window and the
-# union covers every test exactly once (see --shard in tests/conftest.py;
-# slicing is per test file by stable crc32, so module fixtures stay
-# together and shard membership never changes run to run).
+# Sharded tier-1 runner (ROADMAP infra item b, both halves): the full
+# `-m 'not slow'` suite no longer fits one 600 s driver window, so split
+# it into N deterministic slices — each shard gets its own timeout
+# window AND its own invocation (separate pytest process, separate log),
+# and the union covers every test exactly once (see --shard in
+# tests/conftest.py; slicing is per test file by stable crc32, so module
+# fixtures stay together and shard membership never changes run to run).
+#
+# Each shard's output is teed to $LOG_DIR/tier1_shard_<i>.log and its
+# pass count extracted the same way the driver's verify line does
+# (DOTS_PASSED), so per-window results aggregate into one total.
 #
 # Usage:
-#   scripts/run_tier1.sh              # all shards, sequentially
+#   scripts/run_tier1.sh              # all shards, sequential invocations
 #   scripts/run_tier1.sh 2           # just shard 2
+#   PARALLEL=1 scripts/run_tier1.sh  # all shards concurrently (own procs)
 #   SHARDS=4 scripts/run_tier1.sh    # change the shard count
 #   SHARD_TIMEOUT=870 scripts/run_tier1.sh
+#   LOG_DIR=/tmp scripts/run_tier1.sh
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 SHARDS="${SHARDS:-3}"
 SHARD_TIMEOUT="${SHARD_TIMEOUT:-870}"
+PARALLEL="${PARALLEL:-0}"
+LOG_DIR="${LOG_DIR:-/tmp}"
 ONLY="${1:-}"
+
+shard_log() { echo "$LOG_DIR/tier1_shard_$1.log"; }
+
+count_passed() {
+    # same extraction as the driver's tier-1 verify line: progress-dot
+    # lines only, count the dots
+    grep -aE '^[.FEsxX]+( *\[ *[0-9]+%\])?$' "$1" | tr -cd . | wc -c
+}
 
 run_shard() {
     local i="$1"
-    echo "== tier-1 shard $i/$SHARDS (timeout ${SHARD_TIMEOUT}s)"
+    local log
+    log="$(shard_log "$i")"
+    echo "== tier-1 shard $i/$SHARDS (timeout ${SHARD_TIMEOUT}s, log $log)"
     timeout -k 10 "$SHARD_TIMEOUT" \
         env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
         --shard "$i/$SHARDS" --continue-on-collection-errors \
-        -p no:cacheprovider -p no:xdist -p no:randomly
-    local rc=$?
+        -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee "$log"
+    local rc=${PIPESTATUS[0]}
     # crc32-by-file sharding does not guarantee every slice is
     # non-empty; pytest exits 5 for "no tests collected" and that is
     # not a failure of the suite
@@ -33,17 +52,46 @@ run_shard() {
         echo "   (shard $i is empty; not a failure)"
         return 0
     fi
-    return $rc
+    return "$rc"
 }
 
 rc=0
 if [[ -n "$ONLY" ]]; then
     run_shard "$ONLY" || rc=$?
+    echo "shard $ONLY DOTS_PASSED=$(count_passed "$(shard_log "$ONLY")")"
+    exit $rc
+fi
+
+if [[ "$PARALLEL" == "1" ]]; then
+    # one invocation per shard, all concurrent: each is its own pytest
+    # process with its own window-sized timeout — what the per-window
+    # driver does, runnable locally
+    pids=()
+    for i in $(seq 1 "$SHARDS"); do
+        run_shard "$i" > "$(shard_log "$i").console" 2>&1 &
+        pids+=("$!")
+    done
+    for idx in "${!pids[@]}"; do
+        wait "${pids[$idx]}" || rc=$?
+    done
+    for i in $(seq 1 "$SHARDS"); do
+        tail -n 3 "$(shard_log "$i")" | sed "s/^/[shard $i] /"
+    done
 else
     for i in $(seq 1 "$SHARDS"); do
         run_shard "$i" || rc=$?
     done
 fi
+
+total=0
+for i in $(seq 1 "$SHARDS"); do
+    if [[ -f "$(shard_log "$i")" ]]; then
+        n="$(count_passed "$(shard_log "$i")")"
+        echo "shard $i DOTS_PASSED=$n"
+        total=$((total + n))
+    fi
+done
+echo "TOTAL_DOTS_PASSED=$total"
 
 if [[ $rc -eq 0 ]]; then
     echo "tier-1 OK ($SHARDS shards)"

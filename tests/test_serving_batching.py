@@ -440,3 +440,38 @@ def test_health_reports_batching_decision(tmp_path):
         }
     finally:
         srv.stop()
+
+
+def test_solo_fallback_counted_by_reason_on_metrics(tmp_path):
+    """ISSUE 15 satellite: every solo-execution dispatch increments
+    serving_unbatched_total{reason=...} so the ragged-gap closure is
+    measurable on /metrics — model-level unbatchability carries the
+    BatchSpec disabled() code, per-request misses say shape_mismatch."""
+    d, _, _ = _dense_model(tmp_path)
+
+    # coalescing off entirely -> reason=coalescing_off
+    srv = InferenceServer(d, max_batch=1)
+    try:
+        code, _ = _post(srv.address, {"x": [[0.0] * 4]})
+        assert code == 200
+        m = _metrics(srv.address)
+        assert 'serving_unbatched_total{reason="coalescing_off"} 1' in m
+    finally:
+        srv.stop()
+
+    # batchable model, request at an off-spec shape -> shape_mismatch
+    srv = InferenceServer(d, max_batch=8)
+    try:
+        # rank-3 feed: not the declared (rows, 4) row layout, but the
+        # flattening fc still accepts it at its exact shape
+        code, _ = _post(srv.address, {"x": [[[0.0] * 4]]})
+        assert code == 200
+        m = _metrics(srv.address)
+        assert 'serving_unbatched_total{reason="shape_mismatch"} 1' in m
+        # batched traffic never touches the counter
+        code, _ = _post(srv.address, {"x": [[0.0] * 4]})
+        assert code == 200
+        m = _metrics(srv.address)
+        assert 'serving_unbatched_total{reason="shape_mismatch"} 1' in m
+    finally:
+        srv.stop()
