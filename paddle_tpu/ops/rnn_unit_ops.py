@@ -18,10 +18,61 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.lod import unwrap
-from paddle_tpu.registry import register_op
+from paddle_tpu.registry import SkipInferShape, register_op
 
 
-@register_op("lstm_unit", inputs=("X", "C_prev"), outputs=("C", "H"))
+def _make_unit_infer(in_slot, state_slot, mult, gate_slots, state_slots):
+    """Single-step cell shape rule: ``in_slot`` packs ``mult`` gates per
+    hidden unit, ``state_slot`` is (B, D).  Gate-sized outputs mirror
+    the input, state-sized outputs mirror the previous state (derived
+    from the input's last dim when the state shape is unknown).
+    Backfill-only — the registry-audit ratchet's lstm/gru family."""
+
+    def infer(op, block):
+        def var_of(slot):
+            names = op.inputs.get(slot, [])
+            if len(names) != 1 or not names[0]:
+                return None
+            v = block.find_var(names[0])
+            return v if v is not None and v.shape else None
+
+        xv = var_of(in_slot)
+        sv = var_of(state_slot)
+        if sv is not None:
+            state_shape = tuple(sv.shape)
+        elif xv is not None:
+            last = xv.shape[-1]
+            if last >= 0 and last % mult:
+                raise ValueError(
+                    f"{op.type}: {in_slot} last dim {last} must carry "
+                    f"{mult} packed gates per hidden unit")
+            state_shape = tuple(xv.shape[:-1]) + (
+                last // mult if last >= 0 else -1,)
+        else:
+            raise SkipInferShape
+        hit = False
+        targets = [(s, state_shape) for s in state_slots]
+        if xv is not None:
+            targets += [(s, tuple(xv.shape)) for s in gate_slots]
+        for slot, shape in targets:
+            outs = op.outputs.get(slot, [])
+            if len(outs) != 1 or not outs[0]:
+                continue
+            ov = block.find_var(outs[0])
+            if ov is None:
+                continue
+            hit = True
+            if ov.shape is None:
+                ov.shape = shape
+        if not hit:
+            raise SkipInferShape
+
+    return infer
+
+
+@register_op("lstm_unit", inputs=("X", "C_prev"), outputs=("C", "H"),
+             infer_shape=_make_unit_infer("X", "C_prev", 4, (),
+                                          ("C", "H")))
 def _lstm_unit(ctx):
     x = unwrap(ctx.input("X"))                # (B, 4D): i, g (cell cand), f, o
     c_prev = unwrap(ctx.input("C_prev"))      # (B, D)
@@ -40,7 +91,10 @@ def _lstm_unit(ctx):
 
 
 @register_op("gru_unit", inputs=("Input", "HiddenPrev", "Weight", "Bias"),
-             outputs=("Gate", "ResetHiddenPrev", "Hidden"))
+             outputs=("Gate", "ResetHiddenPrev", "Hidden"),
+             infer_shape=_make_unit_infer("Input", "HiddenPrev", 3,
+                                          ("Gate",),
+                                          ("ResetHiddenPrev", "Hidden")))
 def _gru_unit(ctx):
     """u = sigma(xu + h W_u); r = sigma(xr + h W_r);
     c = act(xc + (r*h) W_c); h' = u*h + (1-u)*c  (reference gate order

@@ -471,10 +471,54 @@ def _window_reverse(x, lens):
     return out, src, valid
 
 
+def _make_rnn_seq_infer(mult, gate_slots, hidden_slots):
+    """Fused-RNN shape rule: ``Input`` carries ``mult`` pre-projected
+    gates per hidden unit, so hidden-sized outputs are Input with the
+    last dim divided by ``mult`` and gate-sized outputs mirror Input.
+    Backfill-only (never overwrites builder-stamped shapes), propagates
+    lod_level — the registry-audit ratchet's lstm/gru family."""
+
+    def infer(op, block):
+        ins = op.inputs.get("Input", [])
+        if len(ins) != 1 or not ins[0]:
+            raise SkipInferShape
+        xv = block.find_var(ins[0])
+        if xv is None or xv.shape is None or not xv.shape:
+            raise SkipInferShape
+        last = xv.shape[-1]
+        if last >= 0 and last % mult:
+            raise ValueError(
+                f"{op.type}: Input last dim {last} must carry {mult} "
+                f"packed gates per hidden unit")
+        hid = tuple(xv.shape[:-1]) + (last // mult if last >= 0 else -1,)
+        hit = False
+        targets = ([(s, tuple(xv.shape)) for s in gate_slots]
+                   + [(s, hid) for s in hidden_slots])
+        for slot, shape in targets:
+            outs = op.outputs.get(slot, [])
+            if len(outs) != 1 or not outs[0]:
+                continue
+            ov = block.find_var(outs[0])
+            if ov is None:
+                continue
+            hit = True
+            if ov.shape is None:
+                ov.shape = shape
+            if ov.lod_level == 0 and xv.lod_level:
+                ov.lod_level = xv.lod_level
+        if not hit:
+            raise SkipInferShape
+
+    return infer
+
+
 @register_op("lstm",
              inputs=("Input", "H0", "C0", "Weight", "Bias", "Length"),
              outputs=("Hidden", "Cell", "BatchGate", "BatchCellPreAct"),
-             diff_inputs=("Input", "H0", "C0", "Weight", "Bias"))
+             diff_inputs=("Input", "H0", "C0", "Weight", "Bias"),
+             infer_shape=_make_rnn_seq_infer(
+                 4, ("BatchGate",),
+                 ("Hidden", "Cell", "BatchCellPreAct")))
 def _lstm(ctx):
     """Fused LSTM over a padded batch-major tensor.
 
@@ -629,7 +673,10 @@ def _lstm(ctx):
 @register_op("gru",
              inputs=("Input", "H0", "Weight", "Bias", "Length"),
              outputs=("Hidden", "BatchGate", "BatchResetHiddenPrev", "BatchHidden"),
-             diff_inputs=("Input", "H0", "Weight", "Bias"))
+             diff_inputs=("Input", "H0", "Weight", "Bias"),
+             infer_shape=_make_rnn_seq_infer(
+                 3, ("BatchGate",),
+                 ("Hidden", "BatchResetHiddenPrev", "BatchHidden")))
 def _gru(ctx):
     """Fused GRU (reference: operators/gru_op.cc).  Input (B, T, 3H) of
     pre-projected gates; Weight packs W_rz (H, 2H) and W_c (H, H)."""

@@ -60,6 +60,30 @@ def fits(rows: int, cols: int) -> bool:
             _pick_row_block(rows, cols) >= 8)
 
 
+def block_ok(rows: int, cols: int, rt: int) -> bool:
+    """Validity of an explicit row block at an actual shape: the
+    divisibility/alignment the kernel grid needs plus a hard VMEM cap
+    (x block + y block + f32 temps, ~12MB)."""
+    return (rt >= 8 and rt % 8 == 0 and rows % rt == 0
+            and rt * cols <= 1 << 20)
+
+
+def _resolve_row_block(rows, cols, dtype, budget: int = 1 << 19,
+                       block_rows: int = None):
+    """Explicit block first, then the tuned forward row block from the
+    tuning DB when valid at this shape, else the historical divisor
+    heuristic."""
+    if block_rows is not None and block_ok(rows, cols, block_rows):
+        return block_rows
+    from paddle_tpu.pallas import tuning
+
+    cfg = tuning.lookup("batch_norm", (rows, cols), dtype) or {}
+    rt = cfg.get("block_rows")
+    if rt and block_ok(rows, cols, rt):
+        return rt
+    return _pick_row_block(rows, cols, budget)
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -95,10 +119,12 @@ def _bn_fwd_kernel(x_ref, gamma_ref, beta_ref, y_ref, mean_ref, var_ref,
         var_ref[0:1, :] = v
 
 
-@functools.partial(jax.jit, static_argnames=("eps", "interpret"))
-def _bn_fwd_impl(x2d, gamma, beta, eps: float, interpret: bool = False):
+@functools.partial(jax.jit, static_argnames=("eps", "interpret",
+                                             "block_rows"))
+def _bn_fwd_impl(x2d, gamma, beta, eps: float, interpret: bool = False,
+                 block_rows: int = None):
     R, C = x2d.shape
-    Rt = _pick_row_block(R, C)
+    Rt = _resolve_row_block(R, C, x2d.dtype.name, block_rows=block_rows)
     grid = (2, R // Rt)
     y, mean, var = pl.pallas_call(
         functools.partial(_bn_fwd_kernel, rows=R, eps=eps),

@@ -44,6 +44,24 @@ def _lanes(c):
     return max(c, 128)
 
 
+def _fwd_vmem(bb, w, wp, c, o, kh, kw, fold_kw=False):
+    vmem = (2 * bb * wp * _lanes(c) * 2      # double-buffered x slab
+            + bb * w * _lanes(o) * 4         # f32 accumulator
+            + 2 * bb * w * _lanes(o) * 2     # double-buffered out row
+            + kh * kw * c * _lanes(o) * 2)   # resident filter
+    if fold_kw:
+        vmem += bb * w * kw * c * 2          # staged K=KW*C patch
+    return vmem
+
+
+def fwd_block_ok(bb, n, w, wp, c, o, kh, kw, fold_kw=False) -> bool:
+    """Validity of an explicit forward batch block at an actual shape
+    (the tuning DB's configs are bucket-keyed, so dispatch re-checks)."""
+    return (bb >= 8 and n % bb == 0
+            and _fwd_vmem(bb, w, wp, c, o, kh, kw, fold_kw)
+            <= _VMEM_BUDGET)
+
+
 def _fwd_batch_block(n, w, wp, c, o, kh, kw, fold_kw=False):
     """Largest divisor-of-n batch block whose fwd working set fits
     (x slab and out row double-buffered, resident filter, f32 acc).
@@ -51,13 +69,7 @@ def _fwd_batch_block(n, w, wp, c, o, kh, kw, fold_kw=False):
     caller must fall back to the XLA emitter."""
     for bb in sorted((d for d in range(8, n + 1) if n % d == 0),
                      reverse=True):
-        vmem = (2 * bb * wp * _lanes(c) * 2      # double-buffered x slab
-                + bb * w * _lanes(o) * 4         # f32 accumulator
-                + 2 * bb * w * _lanes(o) * 2     # double-buffered out row
-                + kh * kw * c * _lanes(o) * 2)   # resident filter
-        if fold_kw:
-            vmem += bb * w * kw * c * 2          # staged K=KW*C patch
-        if vmem <= _VMEM_BUDGET:
+        if _fwd_vmem(bb, w, wp, c, o, kh, kw, fold_kw) <= _VMEM_BUDGET:
             return bb
     return None
 
@@ -144,16 +156,36 @@ def _fwd_kernel(x_ref, w_ref, o_ref, *rest, kh_steps, kw_steps, ow,
 
 
 @functools.partial(jax.jit, static_argnames=("padding", "interpret",
-                                             "fold_kw", "with_stats"))
+                                             "fold_kw", "with_stats",
+                                             "bb"))
 def _conv_fwd_impl(x, w, padding: int, interpret: bool = False,
-                   fold_kw: bool = False, with_stats: bool = False):
+                   fold_kw: bool = None, with_stats: bool = False,
+                   bb: int = None):
     n, h, wd, c = x.shape
     kh, kw, c2, o = w.shape
     assert c == c2, (x.shape, w.shape)
     p = padding
     xp = jnp.pad(x, [(0, 0), (p, p), (p, p), (0, 0)])
     wp = wd + 2 * p
-    bb = _fwd_batch_block(n, wd, wp, c, o, kh, kw, fold_kw=fold_kw)
+    # tunables (pallas/tuning): the forward batch block bb and the
+    # fold_kw layout choice (one K=KW*C MXU pass vs KW shifted passes).
+    # Explicit args win (the tuner pins candidates this way); a tuned
+    # bb must re-validate against this actual shape before it replaces
+    # the divisor heuristic.
+    if fold_kw is None or bb is None:
+        from paddle_tpu.pallas import tuning
+
+        cfg = tuning.lookup("conv", (n, h, wd, c, o, kh),
+                            x.dtype.name) or {}
+        if fold_kw is None:
+            fold_kw = bool(cfg.get("fold_kw", False))
+        if bb is None:
+            bb = cfg.get("bb")
+    if bb is not None and not fwd_block_ok(bb, n, wd, wp, c, o, kh, kw,
+                                           fold_kw):
+        bb = None
+    if bb is None:
+        bb = _fwd_batch_block(n, wd, wp, c, o, kh, kw, fold_kw=fold_kw)
     assert bb is not None, (
         f"conv working set exceeds VMEM at every batch block "
         f"({x.shape} w={w.shape}); gate calls behind fits()")

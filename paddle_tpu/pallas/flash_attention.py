@@ -48,6 +48,37 @@ def _pick_block(s: int, pref: int = 512) -> int:
     return b if s % b == 0 else 0
 
 
+# the guessed block preference the tuning DB (pallas/tuning) overrides:
+# blk_q/blk_k default to _pick_block(S, 512)
+DEFAULT_CONFIG = {"blk_pref": 512}
+
+
+def _blocks_ok(S: int, Sk: int, D: int, blk_q: int, blk_k: int) -> bool:
+    """Validity of an explicit (blk_q, blk_k) pair at an actual shape:
+    divisibility plus the same VMEM residency model as ``fits``."""
+    if blk_q < 128 or blk_k < 128 or S % blk_q or Sk % blk_k:
+        return False
+    resident = (blk_q + 2 * blk_k) * D * 2 + blk_q * D * 4 \
+        + blk_q * blk_k * 4
+    return resident <= 12 * 1024 * 1024
+
+
+def _resolve_blocks(BH, S, Sk, D, dtype, blk_q=None, blk_k=None):
+    """Tuned (blk_q, blk_k) from the DB when valid at this shape, else
+    the historical ``_pick_block`` preference."""
+    if blk_q is None or blk_k is None:
+        from paddle_tpu.pallas import tuning
+
+        cfg = tuning.lookup("flash_attention", (BH, S, Sk, D), dtype) or {}
+        blk_q = blk_q or cfg.get("blk_q")
+        blk_k = blk_k or cfg.get("blk_k")
+    blk_q = blk_q or _pick_block(S)
+    blk_k = blk_k or _pick_block(Sk)
+    if not _blocks_ok(S, Sk, D, blk_q, blk_k):
+        blk_q, blk_k = _pick_block(S), _pick_block(Sk)
+    return blk_q, blk_k
+
+
 def fits(B: int, H: int, S: int, D: int) -> bool:
     blk = _pick_block(S)
     if blk < 128 or D > 256 or D % 8 != 0:
@@ -109,13 +140,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             m_scr[:, 0:1] + jnp.log(l)).reshape(1, -1)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "scale", "interpret"))
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "interpret",
+                                             "blk_q", "blk_k"))
 def _flash_fwd_impl(q, k, v, causal: bool, scale: float,
-                    interpret: bool = False):
+                    interpret: bool = False, blk_q: int = None,
+                    blk_k: int = None):
     BH, S, D = q.shape
     Sk = k.shape[1]
-    blk_q = _pick_block(S)
-    blk_k = _pick_block(Sk)
+    blk_q, blk_k = _resolve_blocks(BH, S, Sk, D, q.dtype.name,
+                                   blk_q, blk_k)
     nq, nk = S // blk_q, Sk // blk_k
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
@@ -245,8 +278,9 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal: bool, scale: float,
                     interpret: bool = False, dlse=None):
     BH, S, D = q.shape
     Sk = k.shape[1]
-    blk_q = _pick_block(S)
-    blk_k = _pick_block(Sk)
+    # the same resolved blocks as the forward: lse is saved reshaped to
+    # (BH, nq, blk_q), so fwd and bwd must agree on blk_q
+    blk_q, blk_k = _resolve_blocks(BH, S, Sk, D, q.dtype.name)
     nq, nk = S // blk_q, Sk // blk_k
     delta = jnp.sum(do.astype(_F32) * o.astype(_F32), axis=-1)  # (BH, S)
     if dlse is not None:

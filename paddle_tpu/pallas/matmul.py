@@ -16,6 +16,33 @@ from jax.experimental.pallas import tpu as pltpu
 
 from paddle_tpu.pallas import compat as _compat
 
+# The one place the guessed tile lives (ISSUE 16: `fits` and `matmul`
+# used to repeat bm=256, bk=512, bn=256 independently — a tuned default
+# could desync the fits check from dispatch).  The tuning database
+# (pallas/tuning) overrides these per (shape-bucket, dtype, device).
+DEFAULT_CONFIG = {"bm": 256, "bk": 512, "bn": 256}
+
+
+def _resolve_blocks(m, k, n, dtype, bm, bk, bn):
+    """Fill unset block dims from the tuning DB, else the defaults.
+
+    A tuned config is validated against the ACTUAL shape (the DB keys
+    by bucket, so a bucket-valid config may not divide this shape) and
+    dropped back to the defaults when it doesn't fit.
+    """
+    if bm is not None and bk is not None and bn is not None:
+        return bm, bk, bn
+    from paddle_tpu.pallas import tuning
+
+    cfg = tuning.lookup("matmul", (m, k, n), dtype) or {}
+    got = (bm or cfg.get("bm", DEFAULT_CONFIG["bm"]),
+           bk or cfg.get("bk", DEFAULT_CONFIG["bk"]),
+           bn or cfg.get("bn", DEFAULT_CONFIG["bn"]))
+    if cfg and not fits(m, k, n, *got):
+        got = (bm or DEFAULT_CONFIG["bm"], bk or DEFAULT_CONFIG["bk"],
+               bn or DEFAULT_CONFIG["bn"])
+    return got
+
 
 def _mm_kernel(x_ref, y_ref, o_ref, acc_ref, *, k_steps):
     @pl.when(pl.program_id(2) == 0)
@@ -31,13 +58,18 @@ def _mm_kernel(x_ref, y_ref, o_ref, acc_ref, *, k_steps):
         o_ref[:] = acc_ref[:].astype(o_ref.dtype)
 
 
-def fits(m, k, n, bm=256, bk=512, bn=256) -> bool:
+def fits(m, k, n, bm=None, bk=None, bn=None) -> bool:
+    bm = bm or DEFAULT_CONFIG["bm"]
+    bk = bk or DEFAULT_CONFIG["bk"]
+    bn = bn or DEFAULT_CONFIG["bn"]
     return m % bm == 0 and k % bk == 0 and n % bn == 0
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
-def matmul(x, y, bm: int = 256, bk: int = 512, bn: int = 256,
+def matmul(x, y, bm: int = None, bk: int = None, bn: int = None,
            interpret: bool = False):
+    """Unset block dims resolve through the tuning DB (pallas/tuning),
+    falling back to ``DEFAULT_CONFIG`` — explicit args always win."""
     return _matmul_impl(x, y, bm, bk, bn, interpret)
 
 
@@ -57,10 +89,11 @@ matmul.defvjp(_matmul_fwd, _matmul_bwd)
 
 
 @functools.partial(jax.jit, static_argnames=("bm", "bk", "bn", "interpret"))
-def _matmul_impl(x, y, bm: int = 256, bk: int = 512, bn: int = 256,
+def _matmul_impl(x, y, bm: int = None, bk: int = None, bn: int = None,
                  interpret: bool = False):
     m, k = x.shape
     k2, n = y.shape
+    bm, bk, bn = _resolve_blocks(m, k, n, x.dtype.name, bm, bk, bn)
     assert k == k2 and fits(m, k, n, bm, bk, bn), (x.shape, y.shape)
     k_steps = k // bk
     return pl.pallas_call(

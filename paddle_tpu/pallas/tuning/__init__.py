@@ -1,0 +1,123 @@
+"""Pallas kernel autotuning: measured tile configs behind every kernel.
+
+Every kernel in ``paddle_tpu/pallas`` (and the ragged paged-attention
+decode kernel) used to ship guessed tile sizes.  This package replaces
+the guesses with a TVM-style loop (PAPERS.md):
+
+- ``space.py``    — per-kernel-family config spaces: tunable block/
+  tile/grid parameters plus a validity predicate reusing each kernel's
+  ``fits()``-style VMEM/divisibility checks;
+- ``measure.py``  — on-device measurement: compile + best-of-N
+  chain-block timing (the ``bench.py`` idiom), robust to configs that
+  fail to lower (recorded infeasible, never a crash);
+- ``db.py``       — the persistent, checked-in JSON database keyed by
+  ``(kernel, shape-bucket, dtype, device-kind)``;
+- ``bucket.py``   — the power-of-two shape ladder shared with the
+  serving bucketer, so one tuned config covers a bucket;
+- ``tune.py``     — the ``paddle tune`` CLI that reproduces the whole
+  database from one command and emits tuned-vs-default speedup tables.
+
+Dispatch contract: every kernel entry point calls ``lookup()`` when the
+caller did not pin a config, validates the hit against the *actual*
+shape with its own ``fits()`` check, and falls back to its hard-coded
+default on a miss — so with no database (or an empty one) behavior is
+bit-identical to an untuned tree.
+
+``PADDLE_TPU_TUNING_DB`` overrides the database path (``off``/``0``
+disables lookup entirely).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional, Sequence
+
+from paddle_tpu.pallas.tuning.bucket import (  # noqa: F401
+    bucket_dim,
+    bucket_ladder,
+    bucket_shape,
+)
+from paddle_tpu.pallas.tuning.db import (  # noqa: F401
+    DEFAULT_PATH,
+    SCHEMA,
+    TuningDB,
+    current_device_kind,
+    make_key,
+    normalize_device_kind,
+)
+
+_LOCK = threading.Lock()
+# "unset" sentinel: resolve from env/default path on first use
+_UNSET = object()
+_STATE: Dict[str, Any] = {"db": _UNSET}
+
+_M_LOOKUP = None  # lazy counter handle (observability imports numpy)
+
+
+def _lookup_metric():
+    global _M_LOOKUP
+    if _M_LOOKUP is None:
+        from paddle_tpu.observability import metrics as _metrics
+
+        _M_LOOKUP = _metrics.counter(
+            "tuning_db_lookup_total",
+            "kernel-dispatch tuning-database consultations by result "
+            "(hit = a tuned config was applied, miss = hard-coded "
+            "defaults; counted at trace time, not per device step)")
+    return _M_LOOKUP
+
+
+def _resolve_default() -> TuningDB:
+    env = os.environ.get("PADDLE_TPU_TUNING_DB", "")
+    if env.lower() in ("off", "0", "none", "disabled"):
+        return TuningDB()
+    path = env or DEFAULT_PATH
+    return TuningDB.load_or_empty(path)
+
+
+def get_db() -> TuningDB:
+    """The process-active tuning database (loaded once, cached)."""
+    with _LOCK:
+        if _STATE["db"] is _UNSET:
+            _STATE["db"] = _resolve_default()
+        return _STATE["db"]
+
+
+def set_db(db: "TuningDB | str | None") -> None:
+    """Swap the active database: a ``TuningDB``, a path, or ``None`` to
+    re-resolve from the environment on next use (tests/CLI)."""
+    with _LOCK:
+        if db is None:
+            _STATE["db"] = _UNSET
+        elif isinstance(db, str):
+            _STATE["db"] = TuningDB.load_or_empty(db)
+        else:
+            _STATE["db"] = db
+
+
+def disable() -> None:
+    """Force empty-DB dispatch (hard-coded defaults) for this process."""
+    set_db(TuningDB())
+
+
+def lookup(kernel: str, shape: Sequence[int], dtype: str,
+           device_kind: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    """Dispatch-side query: the tuned config for this kernel at this
+    shape's bucket, or ``None`` (= use the hard-coded default).
+
+    The caller MUST validate the returned config against the actual
+    shape (its ``fits()`` predicate): an entry tuned at the bucket shape
+    may not divide a smaller in-bucket shape.
+    """
+    db = get_db()
+    if not db.entries:
+        return None  # fast path: empty DB never counts a miss
+    kind = device_kind or current_device_kind()
+    cfg = db.lookup(kernel, shape, dtype, kind)
+    try:
+        _lookup_metric().inc(kernel=kernel,
+                             result="hit" if cfg else "miss")
+    except Exception:
+        pass  # telemetry must never sink dispatch
+    return cfg

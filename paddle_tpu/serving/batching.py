@@ -44,6 +44,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.pallas.tuning import bucket as _bucket
 
 _M_QUEUE_WAIT = _metrics.histogram(
     "serving_queue_wait_seconds",
@@ -62,21 +63,18 @@ _M_UNBATCHED = _metrics.counter(
 
 
 def next_bucket(rows: int) -> int:
-    """Smallest power-of-two >= rows (the padded batch dim)."""
-    if rows <= 1:
-        return 1
-    return 1 << (rows - 1).bit_length()
+    """Smallest power-of-two >= rows (the padded batch dim).
+
+    Delegates to the ladder shared with the kernel autotuner
+    (pallas/tuning/bucket.py) so serving batch buckets and tuning-DB
+    shape buckets can never drift apart.
+    """
+    return _bucket.bucket_dim(rows)
 
 
 def bucket_ladder(max_batch: int) -> Tuple[int, ...]:
     """The bucket shapes a server with this cap compiles: 1,2,4..cap."""
-    out = []
-    b = 1
-    while b < max_batch:
-        out.append(b)
-        b <<= 1
-    out.append(next_bucket(max_batch))
-    return tuple(out)
+    return _bucket.bucket_ladder(max_batch)
 
 
 def propagate_shapes(program) -> None:

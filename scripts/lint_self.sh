@@ -26,11 +26,12 @@ echo "== paddle stats: telemetry registry smoke"
 $PADDLE stats --json > /dev/null
 $PADDLE stats > /dev/null
 
-echo "== ruff: analysis + observability + distributed fault-tolerance + serving + decode"
+echo "== ruff: analysis + observability + distributed fault-tolerance + serving + decode + tuning"
 if command -v ruff >/dev/null 2>&1; then
     ruff check paddle_tpu/analysis/ paddle_tpu/observability/ \
         paddle_tpu/distributed/elastic.py paddle_tpu/distributed/retry.py \
         paddle_tpu/serving/ paddle_tpu/decode/ \
+        paddle_tpu/pallas/tuning/ \
         benchmark/serving_bench.py benchmark/decode_bench.py
 else
     echo "ruff not installed; skipping style pass"
@@ -55,6 +56,19 @@ doc = json.load(open("/tmp/decode_bench_smoke.json"))
 assert doc["schema"] == "paddle_tpu.decode_bench.v1", doc["schema"]
 assert doc["tokens_identical"], "paged decode diverged from the solo oracle"
 assert doc["paged"]["cache"]["miss"] == 0, doc["paged"]["cache"]
+EOF
+
+echo "== paddle tune: smoke (autotuner enumerate/measure/persist/dispatch)"
+$PADDLE tune --kernel=softmax --smoke --output=/tmp/tune_smoke_db.json \
+    > /dev/null
+python - <<'EOF'
+import json
+db = json.load(open("/tmp/tune_smoke_db.json"))
+assert db["schema"] == "paddle_tpu.tuning_db.v1", db["schema"]
+assert db["entries"], "tune smoke recorded no entries"
+art = json.load(open("/tmp/tune_smoke_db.telemetry.json"))
+assert art["schema"] == "paddle_tpu.tune.v1", art["schema"]
+assert art["results"], "tune smoke recorded no results"
 EOF
 
 echo "lint_self OK"
