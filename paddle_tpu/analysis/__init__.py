@@ -32,6 +32,14 @@ from paddle_tpu.analysis.verify import (  # noqa: F401
 )
 from paddle_tpu.analysis import dataflow  # noqa: F401
 from paddle_tpu.analysis import passes  # noqa: F401  (registers passes)
+from paddle_tpu.analysis.optimize import (  # noqa: F401
+    DonationEntry,
+    OptReport,
+    backward_slice,
+    check_parity,
+    donation_mask,
+    optimize_program,
+)
 from paddle_tpu.analysis.registry_audit import (  # noqa: F401
     audit_registry,
     current_gaps,

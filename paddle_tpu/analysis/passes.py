@@ -22,6 +22,13 @@ Stable diagnostic codes (asserted by tests — treat as API):
   PVW05  same-family dtype width mismatch
   PVI01  dead op (result unreachable from fetches/state)
   PVI02  dead variable (declared, never used)
+
+Optimizer diagnostics (emitted by analysis/optimize.py's PassPipeline,
+same Diagnostic records, same stability contract):
+
+  PVO01  optimizer skipped: input program already fails verification
+  PVO02  rewrite pass output failed verification; pass reverted
+  PVO03  dce/slice skipped: fetch set unknown
 """
 
 from __future__ import annotations
@@ -368,7 +375,7 @@ def check_dead_code(ctx: PassContext):
     for idx in range(len(block.ops) - 1, -1, -1):
         op = block.ops[idx]
         writes = dataflow.op_writes(op)
-        keep = (op.type in dataflow.SIDE_EFFECT_OPS
+        keep = (dataflow.op_has_side_effects(op)
                 or op.type in dataflow.PSEUDO_OPS
                 or any(n in live for n in writes))
         if not keep:

@@ -304,10 +304,18 @@ def _lint_load(target, config_args=""):
 
 def cmd_lint(argv):
     """paddle lint <program.json|config.py> [--level=warning] [--strict]
-    [--json] [--fetch=a,b] [--feed=a,b] | paddle lint --audit-registry
+    [--json] [--fetch=a,b] [--feed=a,b] [--optimize]
+    | paddle lint --audit-registry
 
     Run the static verifier (paddle_tpu/analysis) and print structured
     diagnostics.  Exit 1 when errors fire (or warnings, with --strict).
+
+    ``--optimize`` additionally dry-runs the whole-program optimizer
+    (analysis/optimize.py) over each target and prints its report —
+    ops removed per pass, constant folds, CSE hits, and the
+    donation-safety mask — then re-verifies the rewritten program
+    (exit 1 if the optimizer output has any error, which the pipeline's
+    internal verify-or-revert gate should make impossible).
     """
     import json as json_mod
 
@@ -318,6 +326,7 @@ def cmd_lint(argv):
     targets = [a for a in rest if not a.startswith("--")]
     as_json = "--json" in flags
     strict = "--strict" in flags
+    do_optimize = "--optimize" in flags
 
     audit = "--audit-registry" in flags or bool(args.get("audit-registry"))
     diags = []
@@ -336,6 +345,7 @@ def cmd_lint(argv):
               file=sys.stderr)
         return 2
     unusable = False  # a bad target never downgrades to "clean"
+    opt_reports = []  # (target, OptReport) pairs under --optimize
     for target in targets:
         if not os.path.exists(target):
             print(f"lint target not found: {target}", file=sys.stderr)
@@ -362,10 +372,27 @@ def cmd_lint(argv):
             fetches = args["fetch"].split(",")
         diags.extend(analysis.verify_program(
             program, feed_names=feeds, fetch_names=fetches, level=level))
+        if do_optimize:
+            optimized, report = analysis.optimize_program(
+                program, feed_names=feeds, fetch_names=fetches)
+            opt_reports.append((target, report))
+            # the pipeline reverts any pass whose output fails error-
+            # tier verification, so errors here mean a gate bug — fail
+            # loudly rather than report a broken rewrite as clean
+            diags.extend(analysis.verify_program(
+                optimized, feed_names=feeds, fetch_names=fetches,
+                level="error"))
 
     if as_json:
-        print(json_mod.dumps([d.to_dict() for d in diags], indent=1))
+        doc = [d.to_dict() for d in diags]
+        if do_optimize:
+            doc = {"diagnostics": doc,
+                   "optimize": {t: r.to_dict() for t, r in opt_reports}}
+        print(json_mod.dumps(doc, indent=1))
     elif diags or not unusable:  # no "clean" claim if nothing was analyzed
+        for target, report in opt_reports:
+            print(f"== optimize: {target}")
+            print(report.format())
         print(analysis.format_report(diags))
     if unusable:
         return 2

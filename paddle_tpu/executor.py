@@ -10,8 +10,10 @@ shapes hit the cache and launch a single device executable.
 
 State (persistable variables — parameters, optimizer moments, BN
 statistics) is threaded functionally: the compiled program takes the
-state dict as a donated argument and returns the updated dict, so
-parameter updates alias in HBM with no host round-trip.
+state as arguments and returns the written entries.  Buffers the
+donation-safety analyzer (paddle_tpu/analysis/optimize.py) proves dead
+after their last write are donated, so parameter updates alias in HBM
+with no host round-trip; everything else is held undonated.
 """
 
 from __future__ import annotations
@@ -270,6 +272,7 @@ class Executor:
         self.place = place if place is not None else TPUPlace()
         self.strategy = strategy
         self._cache: Dict[Any, _Compiled] = {}
+        self._opt_cache: Dict[Any, Any] = {}  # key -> (program, OptReport)
         self._step = 0
 
     # -- public api ---------------------------------------------------------
@@ -281,11 +284,21 @@ class Executor:
         fetch_list: Optional[Sequence] = None,
         scope: Optional[Scope] = None,
         return_numpy: bool = True,
+        optimize_program: bool = False,
     ):
         program = program or framework.default_main_program()
         scope = scope or global_scope()
         feed = feed or {}
         fetch_list = list(fetch_list or [])
+        fetch_names = tuple(
+            v.name if isinstance(v, Variable) else str(v) for v in fetch_list
+        )
+
+        if optimize_program:
+            # rewrite ahead of the compile cache: the OPTIMIZED program's
+            # fingerprint keys the cache, so the rewritten executable and
+            # the plain one never collide
+            program = self._optimized(program, feed, fetch_names)
 
         block = program.global_block()
         fp = self._program_key(program)
@@ -296,9 +309,6 @@ class Executor:
             name: _convert_feed(v, block.find_var(name)) for name, v in feed.items()
         }
         _M_FEED_SEC.observe(time.perf_counter() - t_feed, program=prog_label)
-        fetch_names = tuple(
-            v.name if isinstance(v, Variable) else str(v) for v in fetch_list
-        )
 
         from paddle_tpu import amp
         from paddle_tpu import pallas as pk
@@ -390,6 +400,30 @@ class Executor:
         return out
 
     # -- internals ----------------------------------------------------------
+
+    def _optimized(self, program: Program, feed: Dict[str, Any],
+                   fetch_names: Sequence[str]) -> Program:
+        """Memoized rewrite-pipeline front end for run(optimize_program=
+        True).  The pipeline is parity-gated internally (verify-or-revert
+        per pass); a program the verifier rejects comes back unchanged."""
+        from paddle_tpu.analysis import optimize as _opt
+
+        key = (self._program_key(program), tuple(sorted(feed)), fetch_names)
+        hit = self._opt_cache.get(key)
+        if hit is None:
+            hit = _opt.optimize_program(
+                program, feed_names=set(feed), fetch_names=fetch_names)
+            self._opt_cache[key] = hit
+        return hit[0]
+
+    def optimize_report(self, program: Program, feed: Dict[str, Any],
+                        fetch_names: Sequence[str]):
+        """The OptReport from a prior run(optimize_program=True) with the
+        same (program, feed names, fetches); None before any such run."""
+        key = (self._program_key(program), tuple(sorted(feed)),
+               tuple(fetch_names))
+        hit = self._opt_cache.get(key)
+        return hit[1] if hit is not None else None
 
     @staticmethod
     def _verify(program: Program, feed_vals: Dict[str, Any],
@@ -504,12 +538,47 @@ class Executor:
                 elif n not in feed_vals:
                     raise RuntimeError(f"fetch target {n!r} is never produced")
 
-        # inputs: persistables that are read before being written;
-        # outputs: every persistable touched (read or written) — with
-        # donation XLA aliases unchanged entries, so no copies happen.
+        # inputs: persistables that are read before being written.
+        # outputs: the jit path returns only the persistables actually
+        # WRITTEN (the scope already holds every read-only buffer;
+        # returning those would force XLA output copies now that
+        # donation is per-entry).  The un-jitted path (build_callable)
+        # keeps the historical read+written contract: callers scan over
+        # fn with the state dict as the loop carry, so input and output
+        # state must share a pytree structure.
         state_names = tuple(read_state)
-        out_state_names = tuple(dict.fromkeys(read_state + written_state))
+        if jit:
+            out_state_names = tuple(dict.fromkeys(written_state))
+        else:
+            out_state_names = tuple(dict.fromkeys(read_state + written_state))
         written_names = tuple(written_state)
+
+        # Donation-safety mask (analysis/optimize.py): donate a state
+        # buffer only when liveness PROVES no op can observe the old
+        # value — overwritten at top level, never read after its last
+        # write, never aliased into a control-flow sub-block.  This
+        # replaces the old all-or-nothing donate_argnums=(0,) on the
+        # whole state dict; the _donation_ok() kill-switch (persistent
+        # jax cache breaks executable aliasing metadata) still forces
+        # the mask empty.
+        donated_names: tuple = ()
+        donation = {}
+        if jit and state_names and _donation_ok():
+            from paddle_tpu.analysis import optimize as _opt
+
+            try:
+                donation = _opt.donation_mask(
+                    program, set(feed_vals), fetch_names)
+            except Exception:
+                donation = {}  # analysis must never block execution
+            donated_names = tuple(
+                n for n in state_names
+                if n in donation and donation[n].eligible)
+        held_names = tuple(n for n in state_names if n not in donated_names)
+        if jit and donation:
+            from paddle_tpu.analysis import optimize as _opt
+
+            _opt.set_donation_gauge(self._program_key(program)[:12], donation)
         ops = [op for op in block.ops if op.type not in ("feed", "fetch")]
 
         strategy = self.strategy
@@ -605,16 +674,41 @@ class Executor:
             return _Compiled(run_block, state_names, written_names, fetch_names,
                              uses_rng)
 
+        # The jitted step takes (donated_state, held_state, feeds[, seed])
+        # so donate_argnums=(0,) donates exactly the buffers the mask
+        # proved safe; the public _Compiled.fn keeps the historical
+        # fn(state, feeds[, seed]) calling convention and splits the dict.
+        def run_block_split(donated, held, feeds, seed=None):
+            merged = dict(held)
+            merged.update(donated)
+            return run_block(merged, feeds, seed)
+
         jit_kwargs: Dict[str, Any] = (
-            {"donate_argnums": (0,)} if _donation_ok() else {})
+            {"donate_argnums": (0,)} if donated_names else {})
         if self.strategy is not None:
-            jit_kwargs.update(
-                self.strategy.jit_shardings(
-                    block, state_names, sorted(feed_vals), uses_rng=uses_rng,
-                    out_state_names=out_state_names,
-                )
+            sh = self.strategy.jit_shardings(
+                block, state_names, sorted(feed_vals), uses_rng=uses_rng,
+                out_state_names=out_state_names,
             )
+            state_sh = sh["in_shardings"][0]
+            jit_kwargs["in_shardings"] = (
+                {n: state_sh[n] for n in donated_names},
+                {n: state_sh[n] for n in held_names},
+            ) + tuple(sh["in_shardings"][1:])
+            jit_kwargs["out_shardings"] = sh["out_shardings"]
         elif self.place._backend is not None:
             jit_kwargs["backend"] = self.place._backend
-        fn = jax.jit(run_block, **jit_kwargs)
+        jfn = jax.jit(run_block_split, **jit_kwargs)
+
+        def _split(state):
+            return ({n: state[n] for n in donated_names},
+                    {n: state[n] for n in held_names})
+
+        def fn(state, feeds, *rest):
+            return jfn(*_split(state), feeds, *rest)
+
+        # preserve the jitted object's introspection surface through the
+        # wrapper (tests/benchmarks call compiled.fn.lower(state, feeds))
+        fn.lower = lambda state, feeds, *rest: jfn.lower(
+            *_split(state), feeds, *rest)
         return _Compiled(fn, state_names, written_names, fetch_names, uses_rng)

@@ -586,19 +586,13 @@ class Program:
         prune.cc:133).  Keeps ops whose outputs (transitively) feed a
         target; a kept control-flow op also keeps every variable its
         sub-blocks read from the enclosing scope, even when not named in
-        the op's own inputs."""
-        target_names = set(_as_name_list(targets))
-        p = self.clone()
-        block = p.global_block()
-        needed = set(target_names)
-        kept: List[Operator] = []
-        for op in reversed(block.ops):
-            if needed & set(op.output_arg_names) or op.type in ("feed",):
-                kept.append(op)
-                needed |= set(op.input_arg_names)
-                needed |= _sub_block_external_reads(op)
-        block.ops = list(reversed(kept))
-        return p
+        the op's own inputs.  Delegates to the analysis layer's
+        fetch-driven backward slicer (analysis/optimize.py), which the
+        optimizer's dce pass shares."""
+        from paddle_tpu.analysis.optimize import backward_slice
+
+        return backward_slice(self, _as_name_list(targets),
+                              keep_side_effects=False)
 
 
 def _sub_block_external_reads(op) -> set:

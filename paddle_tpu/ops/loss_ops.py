@@ -11,7 +11,67 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.lod import rewrap, unwrap
-from paddle_tpu.registry import register_op
+from paddle_tpu.registry import SkipInferShape, register_op
+
+
+def _infer_mirror(in_slot, *out_slots):
+    """Each named output mirrors the single ``in_slot`` input."""
+
+    def infer(op, block):
+        ins = op.inputs.get(in_slot, [])
+        if len(ins) != 1 or not ins[0]:
+            raise SkipInferShape
+        xv = block.find_var(ins[0])
+        if xv is None or xv.shape is None:
+            raise SkipInferShape
+        hit = False
+        for slot in out_slots:
+            outs = op.outputs.get(slot, [])
+            if len(outs) != 1 or not outs[0]:
+                continue
+            ov = block.find_var(outs[0])
+            if ov is None:
+                continue
+            hit = True
+            if ov.shape is None:
+                ov.shape = tuple(xv.shape)
+            if ov.lod_level == 0 and xv.lod_level:
+                ov.lod_level = xv.lod_level
+        if not hit:
+            raise SkipInferShape
+
+    return infer
+
+
+def _infer_rowwise(in_slot, out_slot, mirror=()):
+    """``out_slot`` is a per-row (N, 1) loss column, N taken from the
+    leading dim of ``in_slot`` (first entry for list slots); any
+    ``mirror`` outputs copy the input shape wholesale."""
+
+    def infer(op, block):
+        ins = op.inputs.get(in_slot, [])
+        if not ins or not ins[0]:
+            raise SkipInferShape
+        xv = block.find_var(ins[0])
+        if xv is None or xv.shape is None or not len(xv.shape):
+            raise SkipInferShape
+        outs = op.outputs.get(out_slot, [])
+        if len(outs) != 1 or not outs[0]:
+            raise SkipInferShape
+        ov = block.find_var(outs[0])
+        if ov is None:
+            raise SkipInferShape
+        if ov.shape is None:
+            ov.shape = (xv.shape[0], 1)
+        for slot in mirror:
+            m_outs = op.outputs.get(slot, [])
+            if len(m_outs) != 1 or not m_outs[0]:
+                continue
+            mv = block.find_var(m_outs[0])
+            if mv is not None and mv.shape is None:
+                mv.shape = tuple(xv.shape)
+
+    return infer
 
 
 def _take_label_prob(x, label):
@@ -23,7 +83,8 @@ def _take_label_prob(x, label):
     return picked
 
 
-@register_op("cross_entropy", inputs=("X", "Label"), outputs=("Y",), diff_inputs=("X",))
+@register_op("cross_entropy", inputs=("X", "Label"), outputs=("Y",),
+             diff_inputs=("X",), infer_shape=_infer_rowwise("X", "Y"))
 def _cross_entropy(ctx):
     """-log p[label] over a probability input (reference:
     operators/cross_entropy_op.cc; soft_label supported)."""
@@ -38,7 +99,8 @@ def _cross_entropy(ctx):
 
 
 @register_op("softmax_with_cross_entropy", inputs=("Logits", "Label"),
-             outputs=("Softmax", "Loss"), diff_inputs=("Logits",))
+             outputs=("Softmax", "Loss"), diff_inputs=("Logits",),
+             infer_shape=_infer_rowwise("Logits", "Loss", mirror=("Softmax",)))
 def _softmax_with_cross_entropy(ctx):
     logits = unwrap(ctx.input("Logits")).astype(jnp.float32)
     label = unwrap(ctx.input("Label"))
@@ -52,7 +114,7 @@ def _softmax_with_cross_entropy(ctx):
 
 
 @register_op("sigmoid_cross_entropy_with_logits", inputs=("X", "Label"),
-             diff_inputs=("X",))
+             diff_inputs=("X",), infer_shape=_infer_mirror("X", "Out"))
 def _sigmoid_ce(ctx):
     x = unwrap(ctx.input("X"))
     label = unwrap(ctx.input("Label")).astype(x.dtype)
@@ -62,7 +124,8 @@ def _sigmoid_ce(ctx):
 
 
 @register_op("smooth_l1_loss", inputs=("X", "Y", "InsideWeight", "OutsideWeight"),
-             outputs=("Diff", "Out"), diff_inputs=("X", "Y"))
+             outputs=("Diff", "Out"), diff_inputs=("X", "Y"),
+             infer_shape=_infer_rowwise("X", "Out", mirror=("Diff",)))
 def _smooth_l1(ctx):
     x = unwrap(ctx.input("X"))
     y = unwrap(ctx.input("Y"))
@@ -80,7 +143,8 @@ def _smooth_l1(ctx):
 
 
 @register_op("huber_loss", inputs=("X", "Y"), outputs=("Residual", "Out"),
-             diff_inputs=("X", "Y"))
+             diff_inputs=("X", "Y"),
+             infer_shape=_infer_mirror("X", "Residual", "Out"))
 def _huber(ctx):
     x = unwrap(ctx.input("X"))
     y = unwrap(ctx.input("Y"))
@@ -93,7 +157,8 @@ def _huber(ctx):
 
 
 @register_op("hinge_loss", inputs=("Logits", "Labels"), outputs=("Loss",),
-             diff_inputs=("Logits",))
+             diff_inputs=("Logits",),
+             infer_shape=_infer_mirror("Logits", "Loss"))
 def _hinge(ctx):
     logits = unwrap(ctx.input("Logits"))
     labels = unwrap(ctx.input("Labels")).astype(logits.dtype)
@@ -101,7 +166,8 @@ def _hinge(ctx):
 
 
 @register_op("rank_loss", inputs=("Label", "Left", "Right"), outputs=("Out",),
-             diff_inputs=("Left", "Right"))
+             diff_inputs=("Left", "Right"),
+             infer_shape=_infer_mirror("Left", "Out"))
 def _rank_loss(ctx):
     label = unwrap(ctx.input("Label"))
     left = unwrap(ctx.input("Left"))
@@ -111,7 +177,8 @@ def _rank_loss(ctx):
 
 
 @register_op("margin_rank_loss", inputs=("Label", "X1", "X2"),
-             outputs=("Out", "Activated"), diff_inputs=("X1", "X2"))
+             outputs=("Out", "Activated"), diff_inputs=("X1", "X2"),
+             infer_shape=_infer_mirror("X1", "Out", "Activated"))
 def _margin_rank_loss(ctx):
     label = unwrap(ctx.input("Label"))
     x1 = unwrap(ctx.input("X1"))
@@ -124,7 +191,8 @@ def _margin_rank_loss(ctx):
 
 
 @register_op("log_loss", inputs=("Predicted", "Labels"), outputs=("Loss",),
-             diff_inputs=("Predicted",))
+             diff_inputs=("Predicted",),
+             infer_shape=_infer_mirror("Predicted", "Loss"))
 def _log_loss(ctx):
     p = unwrap(ctx.input("Predicted"))
     l = unwrap(ctx.input("Labels"))
@@ -133,7 +201,8 @@ def _log_loss(ctx):
 
 
 @register_op("modified_huber_loss", inputs=("X", "Y"),
-             outputs=("IntermediateVal", "Out"), diff_inputs=("X",))
+             outputs=("IntermediateVal", "Out"), diff_inputs=("X",),
+             infer_shape=_infer_mirror("X", "IntermediateVal", "Out"))
 def _modified_huber(ctx):
     x = unwrap(ctx.input("X"))
     y = unwrap(ctx.input("Y")).astype(x.dtype)
@@ -144,7 +213,7 @@ def _modified_huber(ctx):
 
 
 @register_op("padded_sequence_cross_entropy", inputs=("X", "Label", "Length"),
-             diff_inputs=("X",))
+             diff_inputs=("X",), infer_shape=_infer_rowwise("X", "Out"))
 def _padded_sequence_cross_entropy(ctx):
     """Per-sequence mean NLL over a padded (B, T, V) probability tensor
     with (B, T) integer labels, masking steps >= Length — the padded
@@ -248,7 +317,8 @@ def _lambda_cost_grad_lower(ctx):
 
 @register_op("lambda_cost", inputs=("Score", "Label", "Length"),
              outputs=("Out",), diff_inputs=("Score",),
-             grad_lower=_lambda_cost_grad_lower)
+             grad_lower=_lambda_cost_grad_lower,
+             infer_shape=_infer_rowwise("Score", "Out"))
 def _lambda_cost(ctx):
     """LambdaRank listwise cost (reference: gserver/layers/CostLayer.cpp
     LambdaCost; v1 lambda_cost).  Forward emits NDCG@k per list (what
@@ -278,7 +348,8 @@ def _lambda_cost(ctx):
 
 
 @register_op("cross_entropy_over_beam", inputs=("Scores", "Ids", "Golds"),
-             outputs=("Out",), diff_inputs=("Scores",))
+             outputs=("Out",), diff_inputs=("Scores",),
+             infer_shape=_infer_rowwise("Scores", "Out"))
 def _cross_entropy_over_beam(ctx):
     """Cross entropy over beam expansions, globally normalized over all
     expanded paths (reference: gserver/layers/CrossEntropyOverBeam.cpp

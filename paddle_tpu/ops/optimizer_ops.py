@@ -9,7 +9,31 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from paddle_tpu.lod import unwrap
-from paddle_tpu.registry import register_op
+from paddle_tpu.registry import SkipInferShape, register_op
+
+
+def _infer_update(**pairs):
+    """Functional in-place updates: each output slot mirrors its paired
+    input slot (``ParamOut=Param``, ``MomentOut=Moment``, ...)."""
+
+    def infer(op, block):
+        hit = False
+        for out_slot, in_slot in pairs.items():
+            ins = op.inputs.get(in_slot, [])
+            outs = op.outputs.get(out_slot, [])
+            if len(ins) != 1 or len(outs) != 1 or not ins[0] or not outs[0]:
+                continue
+            iv = block.find_var(ins[0])
+            ov = block.find_var(outs[0])
+            if iv is None or ov is None or iv.shape is None:
+                continue
+            hit = True
+            if ov.shape is None:
+                ov.shape = tuple(iv.shape)
+        if not hit:
+            raise SkipInferShape
+
+    return infer
 
 
 def _lr(ctx):
@@ -18,7 +42,8 @@ def _lr(ctx):
 
 
 @register_op("sgd", inputs=("Param", "Grad", "LearningRate"),
-             outputs=("ParamOut",), stop_gradient=True)
+             outputs=("ParamOut",), stop_gradient=True,
+             infer_shape=_infer_update(ParamOut="Param"))
 def _sgd(ctx):
     from paddle_tpu.sparse import is_sparse_grad
 
@@ -37,7 +62,8 @@ def _sgd(ctx):
 
 
 @register_op("momentum", inputs=("Param", "Grad", "Velocity", "LearningRate"),
-             outputs=("ParamOut", "VelocityOut"), stop_gradient=True)
+             outputs=("ParamOut", "VelocityOut"), stop_gradient=True,
+             infer_shape=_infer_update(ParamOut="Param", VelocityOut="Velocity"))
 def _momentum(ctx):
     from paddle_tpu.sparse import is_sparse_grad, rowwise_update
 
@@ -74,7 +100,9 @@ def _momentum(ctx):
              inputs=("Param", "Grad", "LearningRate", "Moment1", "Moment2",
                      "Beta1Pow", "Beta2Pow"),
              outputs=("ParamOut", "Moment1Out", "Moment2Out"),
-             stop_gradient=True)
+             stop_gradient=True,
+             infer_shape=_infer_update(ParamOut="Param", Moment1Out="Moment1",
+                                       Moment2Out="Moment2"))
 def _adam(ctx):
     from paddle_tpu.sparse import is_sparse_grad, rowwise_update
 
@@ -115,7 +143,9 @@ def _adam(ctx):
 
 @register_op("adamax",
              inputs=("Param", "Grad", "LearningRate", "Moment", "InfNorm", "Beta1Pow"),
-             outputs=("ParamOut", "MomentOut", "InfNormOut"), stop_gradient=True)
+             outputs=("ParamOut", "MomentOut", "InfNormOut"), stop_gradient=True,
+             infer_shape=_infer_update(ParamOut="Param", MomentOut="Moment",
+                                       InfNormOut="InfNorm"))
 def _adamax(ctx):
     p = unwrap(ctx.input("Param"))
     g = unwrap(ctx.input("Grad")).astype(jnp.float32)
@@ -135,7 +165,8 @@ def _adamax(ctx):
 
 
 @register_op("adagrad", inputs=("Param", "Grad", "Moment", "LearningRate"),
-             outputs=("ParamOut", "MomentOut"), stop_gradient=True)
+             outputs=("ParamOut", "MomentOut"), stop_gradient=True,
+             infer_shape=_infer_update(ParamOut="Param", MomentOut="Moment"))
 def _adagrad(ctx):
     from paddle_tpu.sparse import is_sparse_grad, rowwise_update
 
@@ -165,7 +196,8 @@ def _adagrad(ctx):
 
 
 @register_op("decayed_adagrad", inputs=("Param", "Grad", "Moment", "LearningRate"),
-             outputs=("ParamOut", "MomentOut"), stop_gradient=True)
+             outputs=("ParamOut", "MomentOut"), stop_gradient=True,
+             infer_shape=_infer_update(ParamOut="Param", MomentOut="Moment"))
 def _decayed_adagrad(ctx):
     p = unwrap(ctx.input("Param"))
     g = unwrap(ctx.input("Grad")).astype(jnp.float32)
@@ -180,7 +212,10 @@ def _decayed_adagrad(ctx):
 
 @register_op("adadelta", inputs=("Param", "Grad", "AvgSquaredGrad", "AvgSquaredUpdate"),
              outputs=("ParamOut", "AvgSquaredGradOut", "AvgSquaredUpdateOut"),
-             stop_gradient=True)
+             stop_gradient=True,
+             infer_shape=_infer_update(ParamOut="Param",
+                                       AvgSquaredGradOut="AvgSquaredGrad",
+                                       AvgSquaredUpdateOut="AvgSquaredUpdate"))
 def _adadelta(ctx):
     p = unwrap(ctx.input("Param"))
     g = unwrap(ctx.input("Grad")).astype(jnp.float32)
@@ -197,7 +232,9 @@ def _adadelta(ctx):
 
 
 @register_op("rmsprop", inputs=("Param", "MeanSquare", "LearningRate", "Grad", "Moment"),
-             outputs=("ParamOut", "MomentOut", "MeanSquareOut"), stop_gradient=True)
+             outputs=("ParamOut", "MomentOut", "MeanSquareOut"), stop_gradient=True,
+             infer_shape=_infer_update(ParamOut="Param", MomentOut="Moment",
+                                       MeanSquareOut="MeanSquare"))
 def _rmsprop(ctx):
     p = unwrap(ctx.input("Param"))
     g = unwrap(ctx.input("Grad")).astype(jnp.float32)
@@ -217,7 +254,10 @@ def _rmsprop(ctx):
              inputs=("Param", "SquaredAccumulator", "LinearAccumulator", "Grad",
                      "LearningRate"),
              outputs=("ParamOut", "SquaredAccumOut", "LinearAccumOut"),
-             stop_gradient=True)
+             stop_gradient=True,
+             infer_shape=_infer_update(ParamOut="Param",
+                                       SquaredAccumOut="SquaredAccumulator",
+                                       LinearAccumOut="LinearAccumulator"))
 def _ftrl(ctx):
     p = unwrap(ctx.input("Param")).astype(jnp.float32)
     sq = unwrap(ctx.input("SquaredAccumulator"))
@@ -239,7 +279,8 @@ def _ftrl(ctx):
 
 
 @register_op("proximal_gd", inputs=("Param", "Grad", "LearningRate"),
-             outputs=("ParamOut",), stop_gradient=True)
+             outputs=("ParamOut",), stop_gradient=True,
+             infer_shape=_infer_update(ParamOut="Param"))
 def _proximal_gd(ctx):
     p = unwrap(ctx.input("Param")).astype(jnp.float32)
     g = unwrap(ctx.input("Grad")).astype(jnp.float32)
@@ -252,7 +293,8 @@ def _proximal_gd(ctx):
 
 
 @register_op("proximal_adagrad", inputs=("Param", "Moment", "Grad", "LearningRate"),
-             outputs=("ParamOut", "MomentOut"), stop_gradient=True)
+             outputs=("ParamOut", "MomentOut"), stop_gradient=True,
+             infer_shape=_infer_update(ParamOut="Param", MomentOut="Moment"))
 def _proximal_adagrad(ctx):
     p = unwrap(ctx.input("Param")).astype(jnp.float32)
     m = unwrap(ctx.input("Moment"))

@@ -42,7 +42,7 @@ class ModelBundle:
     replica loads its *own* copy of the parameters from the manifest.
     """
 
-    def __init__(self, model_dir: str):
+    def __init__(self, model_dir: str, optimize: bool = True):
         from paddle_tpu import io
 
         self.model_dir = model_dir
@@ -50,6 +50,21 @@ class ModelBundle:
             io.read_inference_export(model_dir)
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
+        self.opt_report = None
+        if optimize:
+            # every replica serves the optimized program: the rewrite
+            # runs ONCE here and the shared IR keeps all replicas on one
+            # fingerprint (one compile-cache entry, one telemetry key).
+            # The pipeline is parity-gated internally; any failure falls
+            # back to the loaded program untouched.
+            from paddle_tpu import analysis
+
+            try:
+                self.program, self.opt_report = analysis.optimize_program(
+                    self.program, feed_names=set(self.feed_names),
+                    fetch_names=self.fetch_names)
+            except Exception:
+                self.opt_report = None
 
     def batch_spec(self) -> BatchSpec:
         return BatchSpec.from_program(self.program, self.feed_names,

@@ -18,8 +18,30 @@ for conf in demos/mnist_v1/trainer_config.py \
     $PADDLE lint "$conf"
 done
 
+echo "== paddle lint --optimize: rewrite pipeline dry-run over demo configs"
+# the pipeline must leave every demo verifier-clean post-rewrite
+# (exit 1 on any error diagnostic); covers the v1 trainer path
+# (seq2seq, with control-flow sub-blocks the donation analyzer must
+# hold) and the serving MLP the replica pool serves
+$PADDLE lint --optimize demos/seq2seq/trainer_config.py
+$PADDLE lint --optimize demos/serving_mlp/infer_config.py \
+    --feed=x --fetch=prediction
+
 echo "== paddle lint: registry metadata audit"
 $PADDLE lint --audit-registry
+
+echo "== registry ratchet: baseline gap must not regress"
+python - <<'EOF'
+import json
+doc = json.load(open("paddle_tpu/analysis/registry_baseline.json"))
+total = sum(len(v) for v in doc.values())
+LIMIT = 110  # ratchet: only lower this, never raise it
+assert total <= LIMIT, (
+    f"registry baseline gap {total} > {LIMIT}: new/changed ops must "
+    "ship infer_shape rules and input slots instead of growing the "
+    "baseline (paddle_tpu/analysis/registry_audit.py)")
+print(f"registry gap {total} <= {LIMIT}")
+EOF
 
 echo "== paddle stats: telemetry registry smoke"
 # the observability surface must at least import + render cleanly
