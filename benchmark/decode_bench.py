@@ -30,11 +30,28 @@ Artifact
 schema and records the acceptance row (>= 3x tokens/s at equal or
 lower p99, cache hit rate 1.0).
 
+Sharing modes (ISSUE 18)
+------------------------
+``--mode=prefix`` serves a prefix-heavy burst (N requests drawn from a
+handful of long shared prompt prefixes) through a ``TinyDecoderLM``
+engine twice — prefix cache off, then on — and records tokens/s and
+**peak page-pool occupancy** for both.  The cached run must decode
+token-identical ids; the win is skipped prefill work plus aliased
+(copy-on-write) prefix pages.  ``--mode=spec`` decodes the same burst
+greedily and speculatively (n-gram prompt-lookup draft, one ragged
+verify step per chunk) and hard-fails unless the speculative ids are
+token-identical; the acceptance ratio comes from the
+``decode_spec_*`` counters.  ``--mode=sharing`` runs both and writes
+one ``paddle_tpu.decode_bench.v2`` artifact
+(benchmark/DECODE_BENCH_r02.json is such a run).
+
 Usage
 -----
-    python benchmark/decode_bench.py [--requests=64] [--slots=8]
+    python benchmark/decode_bench.py [--mode=compare|prefix|spec|sharing]
+        [--requests=64] [--slots=8]
         [--solo-workers=2] [--max-new-tokens=16] [--pages=96]
-        [--page-size=8] [--out=decode_bench.json] [--smoke]
+        [--page-size=8] [--pages-per-seq=8] [--prefix-pages=4]
+        [--spec-k=4] [--out=decode_bench.json] [--smoke]
 """
 
 from __future__ import annotations
@@ -63,6 +80,7 @@ if os.environ.get("JAX_PLATFORMS"):
         pass
 
 SCHEMA = "paddle_tpu.decode_bench.v1"
+SCHEMA_V2 = "paddle_tpu.decode_bench.v2"
 
 
 class _Params:
@@ -216,14 +234,256 @@ def run_paged(params, requests, max_new, slots, pages, page_size):
     }, [list(r.tokens) for r in reqs]
 
 
+# ---------------------------------------------------------------------------
+# sharing modes (ISSUE 18): prefix cache + speculative decoding
+# ---------------------------------------------------------------------------
+
+
+class _PeakSampler:
+    """Polls ``allocator.pages_in_use`` on a side thread and keeps the
+    max — the pool-occupancy number CoW prefix sharing is supposed to
+    shrink.  Polling can miss a one-tick spike; at decode-step
+    timescales (ms) a 0.5 ms sample period is dense enough."""
+
+    def __init__(self, alloc):
+        self.alloc, self.peak = alloc, 0
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while not self._stop.is_set():
+            v = self.alloc.pages_in_use
+            if v > self.peak:
+                self.peak = v
+            time.sleep(0.0005)
+
+    def __enter__(self):
+        self._t.start()
+        return self
+
+    def __exit__(self, *a):
+        self._stop.set()
+        self._t.join()
+
+
+def _make_lm(args, seed: int = 11):
+    from paddle_tpu.decode.model import TinyDecoderLM
+
+    return TinyDecoderLM(num_pages=args.pages, page_size=args.page_size,
+                         pages_per_seq=args.pages_per_seq, seed=seed)
+
+
+def make_prefix_requests(n: int, page_size: int, prefix_pages: int,
+                         n_prefixes: int = 4, seed: int = 13):
+    """A prefix-heavy burst: every request is one of ``n_prefixes``
+    long shared prefixes (full pages of tokens) plus a short random
+    suffix — the workload prefix caching exists for."""
+    rng = np.random.RandomState(seed)
+    bases = [list(rng.randint(2, 64, prefix_pages * page_size))
+             for _ in range(n_prefixes)]
+    return [bases[rng.randint(n_prefixes)]
+            + list(rng.randint(2, 64, 1 + rng.randint(4)))
+            for _ in range(n)]
+
+
+def make_lm_requests(n: int, seed: int = 17):
+    rng = np.random.RandomState(seed)
+    return [list(rng.randint(2, 64, rng.randint(4, 13))) for _ in range(n)]
+
+
+def _run_lm_burst(engine, requests, sample_alloc=None):
+    engine.submit(requests[0]).wait(600)      # warmup: compile the step
+    peak = 0
+    sampler = (_PeakSampler(sample_alloc) if sample_alloc is not None
+               else None)
+    t0 = time.perf_counter()
+    if sampler:
+        sampler.__enter__()
+    try:
+        reqs = [engine.submit(r) for r in requests]
+        done_at = []
+        for r in reqs:
+            r.wait(600)
+            done_at.append(time.perf_counter() - t0)
+    finally:
+        if sampler:
+            sampler.__exit__()
+            peak = sampler.peak
+    wall = time.perf_counter() - t0
+    tokens = sum(len(r.tokens) for r in reqs)
+    out = {
+        "tokens": tokens,
+        "wall_s": round(wall, 3),
+        "tokens_per_s": round(tokens / wall, 2),
+        **_percentiles(done_at),
+    }
+    if sampler:
+        out["peak_pages_in_use"] = peak
+    return out, [list(r.tokens) for r in reqs]
+
+
+def run_prefix(cache_on: bool, requests, args):
+    from paddle_tpu.decode import GenerationEngine
+
+    lm = _make_lm(args)
+    engine = GenerationEngine(lm, max_slots=args.slots,
+                              max_waiting=len(requests) + 1,
+                              max_new_tokens=args.max_new_tokens,
+                              prefix_cache=cache_on)
+    try:
+        out, ids = _run_lm_burst(engine, requests, sample_alloc=lm.allocator)
+        out["prefix_cache"] = bool(cache_on)
+        if cache_on:
+            out["cache_stats"] = engine.session.prefix_cache.stats()
+    finally:
+        engine.stop()
+    return out, ids
+
+
+def mode_prefix(args):
+    requests = make_prefix_requests(args.requests, args.page_size,
+                                    args.prefix_pages)
+    print(f"== prefix-heavy load, cache OFF ({args.requests} requests, "
+          f"{args.prefix_pages * args.page_size}-token shared prefixes)",
+          file=sys.stderr)
+    off, off_ids = run_prefix(False, requests, args)
+    print(f"   {off['tokens_per_s']} tok/s  "
+          f"peak {off['peak_pages_in_use']} pages", file=sys.stderr)
+    print("== prefix-heavy load, cache ON", file=sys.stderr)
+    on, on_ids = run_prefix(True, requests, args)
+    print(f"   {on['tokens_per_s']} tok/s  "
+          f"peak {on['peak_pages_in_use']} pages  "
+          f"hits {on['cache_stats']['hits']}", file=sys.stderr)
+    if on_ids != off_ids:
+        raise SystemExit("prefix-cached decode diverged from the uncached "
+                         "run — page sharing corrupted the KV")
+    return {
+        "workload": {
+            "requests": args.requests,
+            "shared_prefixes": 4,
+            "prefix_tokens": args.prefix_pages * args.page_size,
+            "max_new_tokens": args.max_new_tokens,
+        },
+        "cache_off": off,
+        "cache_on": on,
+        "tokens_identical": True,
+        "speedup_tokens_per_s": round(
+            on["tokens_per_s"] / max(1e-9, off["tokens_per_s"]), 2),
+        "peak_pages_ratio": round(
+            on["peak_pages_in_use"] / max(1, off["peak_pages_in_use"]), 3),
+    }
+
+
+def _spec_counts():
+    from paddle_tpu.observability import metrics as M
+
+    snap = M.snapshot()
+    out = {}
+    for key, name in (("proposed", "decode_spec_proposed_total"),
+                      ("accepted", "decode_spec_accepted_total")):
+        out[key] = sum(r["value"] for r in
+                       snap.get(name, {"values": []})["values"])
+    return out
+
+
+def mode_spec(args):
+    from paddle_tpu.decode import GenerationEngine
+    from paddle_tpu.decode.spec import NgramDraft
+
+    requests = make_lm_requests(args.requests)
+
+    print(f"== greedy baseline ({args.requests} requests)", file=sys.stderr)
+    base_engine = GenerationEngine(_make_lm(args), max_slots=args.slots,
+                                   max_waiting=len(requests) + 1,
+                                   max_new_tokens=args.max_new_tokens)
+    try:
+        base, base_ids = _run_lm_burst(base_engine, requests)
+    finally:
+        base_engine.stop()
+    print(f"   {base['tokens_per_s']} tok/s", file=sys.stderr)
+
+    print(f"== speculative (ngram draft, k={args.spec_k})", file=sys.stderr)
+    spec_engine = GenerationEngine(_make_lm(args), max_slots=args.slots,
+                                   max_waiting=len(requests) + 1,
+                                   max_new_tokens=args.max_new_tokens,
+                                   spec_draft=NgramDraft(),
+                                   spec_k=args.spec_k)
+    s0 = _spec_counts()
+    try:
+        spec, spec_ids = _run_lm_burst(spec_engine, requests)
+    finally:
+        spec_engine.stop()
+    s1 = _spec_counts()
+    proposed = s1["proposed"] - s0["proposed"]
+    accepted = s1["accepted"] - s0["accepted"]
+    spec["draft"] = f"ngram(k={args.spec_k})"
+    spec["proposed"] = proposed
+    spec["accepted"] = accepted
+    spec["accept_ratio"] = round(accepted / max(1, proposed), 4)
+    print(f"   {spec['tokens_per_s']} tok/s  "
+          f"accept {spec['accept_ratio']}", file=sys.stderr)
+
+    if spec_ids != base_ids:
+        raise SystemExit("speculative decode is not token-identical to "
+                         "greedy — the acceptance rule is broken")
+    return {
+        "workload": {
+            "requests": args.requests,
+            "max_new_tokens": args.max_new_tokens,
+            "spec_k": args.spec_k,
+        },
+        "greedy": base,
+        "speculative": spec,
+        "tokens_identical": True,
+        "speedup_tokens_per_s": round(
+            spec["tokens_per_s"] / max(1e-9, base["tokens_per_s"]), 2),
+    }
+
+
+def main_sharing(args):
+    doc = {
+        "schema": SCHEMA_V2,
+        "model": "paddle_tpu/decode TinyDecoderLM (seed-initialized)",
+        "config": {
+            "slots": args.slots,
+            "pages": args.pages,
+            "page_size": args.page_size,
+            "pages_per_seq": args.pages_per_seq,
+            "backend": os.environ.get("JAX_PLATFORMS", "default"),
+        },
+    }
+    summary = {}
+    if args.mode in ("prefix", "sharing"):
+        doc["prefix"] = mode_prefix(args)
+        summary["prefix_speedup"] = doc["prefix"]["speedup_tokens_per_s"]
+        summary["peak_pages_ratio"] = doc["prefix"]["peak_pages_ratio"]
+    if args.mode in ("spec", "sharing"):
+        doc["spec"] = mode_spec(args)
+        summary["spec_accept_ratio"] = \
+            doc["spec"]["speculative"]["accept_ratio"]
+        summary["spec_speedup"] = doc["spec"]["speedup_tokens_per_s"]
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(json.dumps(summary))
+    print(f"artifact written to {args.out}", file=sys.stderr)
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--mode", default="compare",
+                    choices=("compare", "prefix", "spec", "sharing"))
     ap.add_argument("--requests", type=int, default=128)
     ap.add_argument("--slots", type=int, default=16)
     ap.add_argument("--solo-workers", type=int, default=2)
     ap.add_argument("--max-new-tokens", type=int, default=16)
     ap.add_argument("--pages", type=int, default=96)
     ap.add_argument("--page-size", type=int, default=8)
+    ap.add_argument("--pages-per-seq", type=int, default=8)
+    ap.add_argument("--prefix-pages", type=int, default=4,
+                    help="shared-prefix length in pages (prefix mode)")
+    ap.add_argument("--spec-k", type=int, default=4)
     ap.add_argument("--out", default="decode_bench.json")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny config: exercise the harness, not the claim")
@@ -232,6 +492,9 @@ def main(argv=None):
         args.requests, args.slots = 6, 3
         args.max_new_tokens, args.solo_workers = 5, 1
         args.pages = 24
+        if args.mode != "compare":
+            args.requests, args.pages = 8, 48
+            args.prefix_pages = 2
 
     import jax
 
@@ -246,6 +509,9 @@ def main(argv=None):
         pass
 
     import paddle_tpu  # noqa: F401  (register ops before anything else)
+
+    if args.mode != "compare":
+        return main_sharing(args)
 
     params = _Params()
     # materialize the parameters once (fixed startup seeds) so every

@@ -238,19 +238,62 @@ def bench_seq2seq(batch=None, steps=None, warmup=3):
     from paddle_tpu.framework import TPUPlace
 
     exe = Executor(TPUPlace())
-    with executor_mod.scope_guard(t.parameters.scope):
-        for _ in range(warmup):
-            (l,) = exe.run(prog, feed=feed,
-                           fetch_list=[topo.cost_var.name],
-                           return_numpy=False)
-        float(np.asarray(l).ravel()[0])
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            (l,) = exe.run(prog, feed=feed,
-                           fetch_list=[topo.cost_var.name],
-                           return_numpy=False)
-        float(np.asarray(l).ravel()[0])
-    dt = (time.perf_counter() - t0) / steps
+    if os.environ.get("BENCH_CHAIN", "1") == "1":
+        # scanned K-step training loop, best-of-5 chain blocks — the
+        # bench.py ResNet methodology: per-step dispatch through the
+        # harness tunnel pays a fixed ~6-9 ms RPC per program that a
+        # locally attached chip does not, so the chain times the device
+        # step itself, and the best block drops inter-block jitter
+        # without putting a host sync inside the pipeline.
+        # BENCH_CHAIN=0 restores per-dispatch timing.
+        import jax
+        from jax import lax
+
+        fn, state, feeds, uses_rng = exe.build_callable(
+            prog, {k: np.asarray(v) for k, v in feed.items()},
+            [topo.cost_var.name], scope=t.parameters.scope)
+        K = 5
+
+        def multi(state, feeds, base_seed):
+            def body(s, i):
+                fetches, s2 = (fn(s, feeds, base_seed + i) if uses_rng
+                               else fn(s, feeds))
+                return s2, fetches[0]
+
+            s, losses = lax.scan(body, state, jnp.arange(K))
+            return losses[-1], s
+
+        jm = jax.jit(multi, donate_argnums=(0,))
+        dev_feeds = {k: jnp.asarray(v) for k, v in feeds.items()}
+        out, state = jm(state, dev_feeds, jnp.int32(0))
+        float(np.asarray(out))            # compile + warm chain
+        for _ in range(max(warmup // K - 1, 0)):
+            out, state = jm(state, dev_feeds, jnp.int32(0))
+        float(np.asarray(out))
+        reps = max(steps // K, 2)
+        best, seed = float("inf"), K
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out, state = jm(state, dev_feeds, jnp.int32(seed))
+                seed += K
+            float(np.asarray(out))        # sync once per block
+            best = min(best, time.perf_counter() - t0)
+        dt = best / (reps * K)
+    else:
+        with executor_mod.scope_guard(t.parameters.scope):
+            for _ in range(warmup):
+                (l,) = exe.run(prog, feed=feed,
+                               fetch_list=[topo.cost_var.name],
+                               return_numpy=False)
+            float(np.asarray(l).ravel()[0])
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                (l,) = exe.run(prog, feed=feed,
+                               fetch_list=[topo.cost_var.name],
+                               return_numpy=False)
+            float(np.asarray(l).ravel()[0])
+            dt = (time.perf_counter() - t0) / steps
     tokens = B * S
     # model FLOPs per step (matmul terms only, x3 for fwd+bwd):
     # encoder: emb->3H proj + GRU recurrent 3H*H; decoder per target

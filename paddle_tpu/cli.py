@@ -16,7 +16,8 @@ Subcommands:
                [--request_timeout=SECONDS] [--max_inflight=N]
                [--gen_config=SCRIPT] [--gen_pages=N] [--gen_page_size=N]
                [--gen_pages_per_seq=N] [--gen_slots=N] [--gen_queue=N]
-               [--gen_max_tokens=N]
+               [--gen_max_tokens=N] [--beam_max=K] [--prefix_cache]
+               [--prefix_cache_pages=N] [--spec_draft=ngram] [--spec_k=N]
       (HTTP JSON inference over a save_inference_model export —
        paddle_tpu/serving: bucketed request coalescing into power-of-two
        batch shapes + a pool of executor replicas; --warmup pre-compiles
@@ -24,8 +25,11 @@ Subcommands:
        --max_inflight sheds load with 503 instead of piling up threads.
        --gen_config mounts POST /generate: token streaming over the
        paged-KV continuous-batching decode engine, paddle_tpu/decode —
-       the script defines make_generator() -> (beam_gen, parameters),
-       see demos/seq2seq/gen_config.py)
+       the script defines make_generator() -> (beam_gen, parameters)
+       or make_decode_model() -> paged LM, see demos/seq2seq/
+       gen_config.py; --beam_max enables beam search over CoW sibling
+       slots, --prefix_cache shares prompt-prefix KV pages across
+       requests, --spec_draft/--spec_k enable speculative decoding)
   paddle elastic --coord=HOST:PORT --checkpoint-dir=DIR [--job=NAME]
                  [--tasks=N] [--passes=P] [--worker-id=ID] ...
       (preemption-safe demo training worker —
@@ -144,13 +148,19 @@ def _serve(make_server, argv, label):
     return 0
 
 
-def _load_generator(args):
+def _load_generator(args, flags=()):
     """Build a paged-KV GenerationEngine from a --gen_config script.
 
     The script is exec'd and must define ``make_generator()`` returning
     ``(beam_gen, parameters)`` — a v1 ``beam_search`` spec plus trained
-    parameters (see demos/seq2seq/gen_config.py).  Page-pool geometry
-    comes from the --gen_* flags."""
+    parameters (see demos/seq2seq/gen_config.py) — or
+    ``make_decode_model()`` returning a paged decoder-LM model (the
+    path that supports prefix caching and speculative decoding).
+    Page-pool geometry comes from the --gen_* flags; ``--beam_max=K``
+    enables POST /generate ``{"beam": k}``; ``--prefix_cache`` /
+    ``--spec_draft=ngram`` (or a ``make_draft_model()`` in the config)
+    turn on prompt-prefix page reuse and speculative decoding for
+    models that support them."""
     _cwd_importable()
     from paddle_tpu.decode import GenerationEngine
 
@@ -158,9 +168,30 @@ def _load_generator(args):
     glb = {"__file__": path, "__name__": "__paddle_serve_gen__"}
     with open(path) as f:
         exec(compile(f.read(), path, "exec"), glb)
+    beam_max = int(args.get("beam_max", 0))
+    spec_draft = None
+    if "make_draft_model" in glb:
+        spec_draft = glb["make_draft_model"]()
+    elif args.get("spec_draft") == "ngram":
+        from paddle_tpu.decode.spec import NgramDraft
+
+        spec_draft = NgramDraft()
+    if "make_decode_model" in glb:
+        return GenerationEngine(
+            glb["make_decode_model"](),
+            max_slots=int(args.get("gen_slots", 8)),
+            max_waiting=int(args.get("gen_queue", 64)),
+            max_new_tokens=int(args.get("gen_max_tokens", 32)),
+            prefix_cache="--prefix_cache" in flags,
+            prefix_cache_pages=(int(args["prefix_cache_pages"])
+                                if args.get("prefix_cache_pages") else None),
+            spec_draft=spec_draft,
+            spec_k=int(args.get("spec_k", 4)),
+            beam_max=beam_max)
     if "make_generator" not in glb:
         raise RuntimeError(
-            f"{path} defines no make_generator() -> (beam_gen, parameters)")
+            f"{path} defines no make_generator() -> (beam_gen, parameters) "
+            "and no make_decode_model() -> paged decoder model")
     beam_gen, parameters = glb["make_generator"]()
     return GenerationEngine.for_seq2seq(
         beam_gen, parameters,
@@ -170,7 +201,8 @@ def _load_generator(args):
         max_slots=int(args.get("gen_slots", 8)),
         max_waiting=int(args.get("gen_queue", 64)),
         max_new_tokens=(int(args["gen_max_tokens"])
-                        if args.get("gen_max_tokens") else None))
+                        if args.get("gen_max_tokens") else None),
+        beam_max=beam_max)
 
 
 def cmd_serve(argv):
@@ -179,13 +211,17 @@ def cmd_serve(argv):
     [--request_timeout=S] [--max_inflight=N]
     [--gen_config=SCRIPT --gen_pages=N --gen_page_size=N
      --gen_pages_per_seq=N --gen_slots=N --gen_queue=N
-     --gen_max_tokens=N] — HTTP inference over a save_inference_model
+     --gen_max_tokens=N --beam_max=K --prefix_cache
+     --prefix_cache_pages=N --spec_draft=ngram --spec_k=N]
+    — HTTP inference over a save_inference_model
     export (paddle_tpu/serving): concurrent requests coalesce into
     power-of-two batch buckets dispatched across a pool of executor
     replicas, with graceful-degradation bounds (504 on deadline expiry,
     503 on overload).  With --gen_config, also mounts POST /generate —
     token streaming over the paged-KV continuous-batching decode
-    engine (paddle_tpu/decode)."""
+    engine (paddle_tpu/decode); --beam_max enables {"beam": k} beam
+    search, --prefix_cache shares prompt-prefix KV pages across
+    requests, --spec_draft/--spec_k turn on speculative decoding."""
     from paddle_tpu.serving import InferenceServer
 
     args, rest = _kv_args(argv)
@@ -207,7 +243,7 @@ def cmd_serve(argv):
             max_batch=int(a.get("max_batch", 8)),
             batch_timeout_ms=float(a.get("batch_timeout_ms", 0.0)),
             warmup="--warmup" in rest,
-            generator=(_load_generator(a) if a.get("gen_config")
+            generator=(_load_generator(a, rest) if a.get("gen_config")
                        else None)),
         argv, "inference server")
 

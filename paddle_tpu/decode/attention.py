@@ -300,6 +300,146 @@ def ragged_paged_attention(q, k_pages, v_pages, page_tables, lens,
     )(ptab, lens32, q, k_pages, v_pages)
 
 
+def ragged_paged_attention_chunk_reference(q, k_pages, v_pages,
+                                           page_tables, lens, scale=None):
+    """Chunked decode attention: ``T`` query tokens per slot in one step
+    (speculative verification / suffix prefill).
+
+    q (S, T, H, D); k/v_pages (N, page, H, D); page_tables (S, P);
+    lens (S,) = context rows *before* the chunk -> out (S, T, H, D).
+    Query token ``j`` of slot ``s`` sits at position ``lens[s] + j`` and
+    attends over pool positions ``t < lens[s] + j + 1`` — the chunk's
+    own rows are causally visible because the caller writes the chunk's
+    K/V into the pages before attending (same convention as the
+    single-token step, which calls with ``lens + 1``).
+    """
+    S, T, H, D = q.shape
+    page = k_pages.shape[1]
+    P = page_tables.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+    k = k_pages[page_tables].reshape(S, P * page, H, D).astype(_F32)
+    v = v_pages[page_tables].reshape(S, P * page, H, D).astype(_F32)
+    s = jnp.einsum("sjhd,sthd->sjht", q.astype(_F32), k) * scale
+    t_pos = jnp.arange(P * page)
+    limit = lens.reshape(-1, 1)[:, None] + jnp.arange(T)[None, :, None] + 1
+    mask = t_pos[None, None, :] < limit                  # (S, T, Ptot)
+    s = jnp.where(mask[:, :, None, :], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("sjht,sthd->sjhd", p, v)
+    return out.astype(q.dtype)
+
+
+def _rpa_chunk_kernel(ptab_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                      m_scr, l_scr, acc_scr, *, scale, page, npp, T):
+    """Chunked variant of ``_rpa_kernel``: the q block holds the slot's
+    whole T-token chunk; masking offsets the length limit per row."""
+    s = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    seq_len = lens_ref[s]
+
+    # last chunk row reaches position seq_len + T - 1: pages wholly past
+    # that contribute to no query row and skip their math + DMA
+    @pl.when(p * page < seq_len + T)
+    def _page():
+        q = q_ref[0].astype(_F32)                       # (T, H, D)
+        k = k_ref[0].astype(_F32)                       # (page, H, D)
+        v = v_ref[0].astype(_F32)
+        # scores (H, T, page): batch over H, contract D
+        sc = jax.lax.dot_general(
+            jnp.swapaxes(q, 0, 1), jnp.swapaxes(k, 0, 1),
+            (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=_F32) * scale
+        t_pos = p * page + jax.lax.broadcasted_iota(jnp.int32, sc.shape, 2)
+        row = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
+        sc = jnp.where(t_pos < seq_len + row + 1, sc, _NEG_INF)
+        m_prev = m_scr[...]                             # (H, T, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=2, keepdims=True))
+        pr = jnp.exp(sc - m_new)                        # (H, T, page)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[...] = l_scr[...] * corr + jnp.sum(pr, axis=2, keepdims=True)
+        m_scr[...] = m_new
+        # (H, T, page) x (H, page, D) batched over H -> (H, T, D)
+        acc_scr[...] = acc_scr[...] * corr + jax.lax.dot_general(
+            pr, jnp.swapaxes(v, 0, 1), (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=_F32)
+
+    @pl.when(p == npp - 1)
+    def _finish():
+        l = l_scr[...]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = jnp.swapaxes(acc_scr[...] / l, 0, 1).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def ragged_paged_attention_chunk(q, k_pages, v_pages, page_tables, lens,
+                                 scale=None, interpret: bool = False):
+    """Pallas chunked ragged paged-attention (same contract as
+    ``ragged_paged_attention_chunk_reference``): one grid step per
+    (slot, page), the whole T-token chunk resident in the q/o blocks."""
+    S, T, H, D = q.shape
+    page = k_pages.shape[1]
+    P = page_tables.shape[1]
+    if scale is None:
+        scale = D ** -0.5
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, P),
+        in_specs=[
+            pl.BlockSpec((1, T, H, D), lambda s, p, pt, ln: (s, 0, 0, 0)),
+            pl.BlockSpec((1, page, H, D),
+                         lambda s, p, pt, ln: (pt[s, p], 0, 0, 0)),
+            pl.BlockSpec((1, page, H, D),
+                         lambda s, p, pt, ln: (pt[s, p], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, T, H, D),
+                               lambda s, p, pt, ln: (s, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, T, 1), _F32),     # running max
+            pltpu.VMEM((H, T, 1), _F32),     # running normalizer
+            pltpu.VMEM((H, T, D), _F32),     # output accumulator
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_rpa_chunk_kernel, scale=scale, page=page,
+                          npp=P, T=T),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, T, H, D), q.dtype),
+        compiler_params=_compat.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(page_tables.astype(jnp.int32), lens.astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
+def paged_chunk_attention(q, k_pages, v_pages, page_tables, lens,
+                          scale=None):
+    """Dispatcher for the chunked step (mirrors ``paged_attention``):
+    the Pallas chunk kernel when the pallas mode allows it, else the
+    jnp reference — identical contract."""
+    from paddle_tpu import pallas as pk
+
+    S, T, H, D = q.shape
+    mode = pk.mode()
+    if mode != "off" and fits(k_pages.shape[1], H, D):
+        if mode == "on":
+            return ragged_paged_attention_chunk(
+                q, k_pages, v_pages, page_tables, lens, scale=scale,
+                interpret=pk.interpret_mode())
+        if pk._tpu_backend():
+            return ragged_paged_attention_chunk(
+                q, k_pages, v_pages, page_tables, lens, scale=scale)
+    return ragged_paged_attention_chunk_reference(
+        q, k_pages, v_pages, page_tables, lens, scale=scale)
+
+
 def paged_attention(q, k_pages, v_pages, page_tables, lens, scale=None):
     """Dispatcher: the Pallas kernel when the pallas mode allows it
     (forced on, or auto on a TPU backend at supported shapes), else the

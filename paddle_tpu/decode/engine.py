@@ -16,21 +16,36 @@ from typing import Callable, List, Optional
 
 from paddle_tpu.decode.session import (
     AdmissionRefused,
+    BeamRequest,
     DecodeRequest,
     DecodeSession,
 )
 
-__all__ = ["AdmissionRefused", "GenerationEngine"]
+__all__ = ["AdmissionRefused", "BeamRequest", "DecodeRequest",
+           "GenerationEngine"]
 
 
 class GenerationEngine:
     def __init__(self, model, max_slots: int = 8,
                  max_waiting: Optional[int] = 64,
                  max_new_tokens: int = 32,
-                 prompt_of: Optional[Callable] = None):
+                 prompt_of: Optional[Callable] = None,
+                 prefix_cache: bool = False,
+                 prefix_cache_pages: Optional[int] = None,
+                 spec_draft=None, spec_k: int = 4,
+                 beam_max: int = 0):
         self.model = model
+        cache = None
+        if prefix_cache and getattr(model, "supports_prefix_cache", False):
+            from paddle_tpu.decode.prefix import PrefixCache
+
+            cache = PrefixCache(model.allocator, model.page_size,
+                                capacity_pages=prefix_cache_pages)
         self.session = DecodeSession(model, max_slots=max_slots,
-                                     max_waiting=max_waiting)
+                                     max_waiting=max_waiting,
+                                     prefix_cache=cache,
+                                     spec_draft=spec_draft, spec_k=spec_k)
+        self.beam_max = int(beam_max)
         self.max_new_tokens_cap = int(max_new_tokens)
         # identity by default: most models (TinyDecoderLM) take the id
         # list as-is; for_seq2seq overrides with the v2 reader-row wrap
@@ -46,6 +61,7 @@ class GenerationEngine:
                     page_size: int = 8, pages_per_seq: int = 2,
                     max_slots: int = 8, max_waiting: Optional[int] = 64,
                     max_new_tokens: Optional[int] = None,
+                    beam_max: int = 0,
                     place=None) -> "GenerationEngine":
         from paddle_tpu.decode.seq2seq import PagedSeq2SeqModel
 
@@ -56,24 +72,53 @@ class GenerationEngine:
                    max_new_tokens=(max_new_tokens
                                    if max_new_tokens is not None
                                    else beam_gen.max_length),
+                   beam_max=beam_max,
                    prompt_of=lambda ids: [ids])
 
     # -- submission ---------------------------------------------------------
 
-    def submit(self, src_ids: List[int],
-               max_new_tokens: Optional[int] = None,
-               on_token: Optional[Callable[[int], None]] = None,
-               deadline: Optional[float] = None) -> DecodeRequest:
-        """Queue a generation request.  Raises AdmissionRefused when the
-        engine cannot take it (503-shaped), otherwise returns the
-        request handle — ``wait()``/``result()`` or stream via
-        ``on_token``."""
+    def _budget(self, max_new_tokens: Optional[int]) -> int:
         budget = self.max_new_tokens_cap
         if max_new_tokens is not None:
             budget = max(1, min(int(max_new_tokens), budget))
+        return budget
+
+    def submit(self, src_ids: List[int],
+               max_new_tokens: Optional[int] = None,
+               on_token: Optional[Callable[[int], None]] = None,
+               deadline: Optional[float] = None,
+               temperature: Optional[float] = None,
+               top_k: Optional[int] = None,
+               seed: Optional[int] = None) -> DecodeRequest:
+        """Queue a generation request.  Raises AdmissionRefused when the
+        engine cannot take it (503-shaped), otherwise returns the
+        request handle — ``wait()``/``result()`` or stream via
+        ``on_token``.  ``temperature``/``top_k``/``seed`` switch the
+        slot from greedy argmax to seeded sampling."""
         req = DecodeRequest(self._prompt_of(list(src_ids)),
-                            max_new_tokens=budget, on_token=on_token,
-                            deadline=deadline)
+                            max_new_tokens=self._budget(max_new_tokens),
+                            on_token=on_token, deadline=deadline,
+                            temperature=temperature, top_k=top_k,
+                            seed=seed)
+        self.session.submit(req)
+        self._wake.set()
+        return req
+
+    def submit_beam(self, src_ids: List[int], beam_size: int,
+                    max_new_tokens: Optional[int] = None,
+                    deadline: Optional[float] = None) -> BeamRequest:
+        """Queue a beam-search request (k sibling slots sharing the
+        prompt's pages copy-on-write).  Refused when beam search is
+        disabled (``beam_max`` 0) or wider than the configured cap."""
+        if beam_size > self.beam_max:
+            raise AdmissionRefused(
+                "beam_disabled" if self.beam_max == 0 else "beam_too_wide",
+                f"beam_size {beam_size} exceeds the engine cap "
+                f"({self.beam_max})")
+        req = BeamRequest(self._prompt_of(list(src_ids)),
+                          beam_size=beam_size,
+                          max_new_tokens=self._budget(max_new_tokens),
+                          deadline=deadline)
         self.session.submit(req)
         self._wake.set()
         return req
@@ -82,17 +127,24 @@ class GenerationEngine:
 
     def info(self) -> dict:
         alloc = self.model.allocator
-        return {
+        out = {
             "slots": self.session.max_slots,
             "active": self.session.active,
             "waiting": self.session.waiting,
             "page_size": self.model.page_size,
             "pages_total": alloc.num_pages - 1,   # page 0 reserved
             "pages_free": alloc.free_pages,
+            "pages_shared": alloc.pages_shared,
             "max_new_tokens": self.max_new_tokens_cap,
             "bos_id": self.model.bos_id,
             "eos_id": self.model.eos_id,
+            "beam_max": self.beam_max,
+            "speculative": self.session._spec_draft is not None,
         }
+        cache = self.session.prefix_cache
+        if cache is not None:
+            out["prefix_cache"] = cache.stats()
+        return out
 
     # -- lifecycle ----------------------------------------------------------
 

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from paddle_tpu.decode.attention import (
     dense_prefill_attention,
     paged_attention,
+    paged_chunk_attention,
 )
 from paddle_tpu.decode.paged_kv import PageAllocator
 
@@ -67,6 +68,8 @@ def _ln(x, scale):
 
 class TinyDecoderLM:
     grows_kv = True
+    supports_prefix_cache = True      # prefill accepts cached_len
+    emits_probs = False               # decode returns raw logits
     state_specs: List[Tuple[tuple, type]] = []   # position == KV length
 
     def __init__(self, vocab: int = 64, d_model: int = 32,
@@ -137,10 +140,28 @@ class TinyDecoderLM:
         t[:len(pages)] = np.asarray(pages, np.int32)
         return t
 
-    def prefill(self, prompt: Sequence[int], pages: Sequence[int]):
+    def prefill(self, prompt: Sequence[int], pages: Sequence[int],
+                cached_len: int = 0):
+        """Page the prompt's K/V and return (ctx_len, states, last
+        logits).  With ``cached_len`` > 0 (a prefix-cache hit) the first
+        ``cached_len`` rows already live in ``pages`` — only the suffix
+        is computed, attending over the cached pages through the chunked
+        paged kernel, and only the suffix's K/V rows are written."""
         toks = jnp.asarray(list(prompt), jnp.int32)
-        logits, ks, vs = self._forward(toks)
         T = toks.shape[0]
+        if cached_len:
+            if not (0 < cached_len < T and cached_len % self.page_size == 0):
+                raise ValueError(
+                    f"cached_len {cached_len} must be a positive multiple "
+                    f"of page_size strictly inside the {T}-token prompt")
+            table = self.pool_table(pages)
+            logits, self.k_pool, self.v_pool = _prefill_chunk(
+                self.params, self.k_pool, self.v_pool,
+                jnp.asarray(table), np.int32(cached_len),
+                toks[cached_len:], heads=self.heads,
+                page_size=self.page_size)
+            return int(T), [], logits[-1]
+        logits, ks, vs = self._forward(toks)
         cap = len(pages) * self.page_size
         pad = cap - T
         idx = jnp.asarray(np.asarray(pages, np.int32))
@@ -152,6 +173,27 @@ class TinyDecoderLM:
         self.v_pool = self.v_pool.at[:, idx].set(vr)
         return int(T), [], logits[-1]
 
+    def copy_page(self, src: int, dst: int) -> None:
+        """Device copy of one page across both pools (the CoW split)."""
+        self.k_pool, self.v_pool = _copy_pools_page(
+            self.k_pool, self.v_pool, np.int32(src), np.int32(dst))
+
+    def verify_chunk(self, tokens: np.ndarray, states, tables: np.ndarray,
+                     lens: np.ndarray):
+        """Speculative verification: feed ``k`` tokens per slot in ONE
+        step (tokens (S, k)), appending all k K/V rows and attending
+        with per-row causal offsets.  Returns logits (S, k, V) — row j
+        scores the token *after* tokens[:, j].  Rollback of rejected
+        rows is the caller's business: stale K/V past ``lens`` is
+        unreachable through the length mask."""
+        logits, self.k_pool, self.v_pool = _verify_step(
+            self.params, self.k_pool, self.v_pool,
+            jnp.asarray(tables.astype(np.int32)),
+            jnp.asarray(lens.astype(np.int32)),
+            jnp.asarray(tokens.astype(np.int32)),
+            heads=self.heads, page_size=self.page_size)
+        return np.asarray(logits), []
+
     def decode(self, tokens: np.ndarray, states, tables: np.ndarray,
                lens: np.ndarray):
         logits, self.k_pool, self.v_pool = _decode_step(
@@ -161,6 +203,78 @@ class TinyDecoderLM:
             jnp.asarray(tokens[:, 0].astype(np.int32)),
             heads=self.heads, page_size=self.page_size)
         return np.asarray(logits), []
+
+
+@jax.jit
+def _copy_pools_page(k_pool, v_pool, src, dst):
+    return (k_pool.at[:, dst].set(k_pool[:, src]),
+            v_pool.at[:, dst].set(v_pool[:, src]))
+
+
+@functools.partial(jax.jit, static_argnames=("heads", "page_size"))
+def _prefill_chunk(params, k_pool, v_pool, table, cached_len, tokens, *,
+                   heads, page_size):
+    """Suffix prefill over cached pages: the suffix's Ts tokens are one
+    chunk at positions cached_len..cached_len+Ts-1; attention sees the
+    cached prefix rows plus the causal part of the suffix itself.
+    Retraces per suffix length, like the dense prefill."""
+    Ts = tokens.shape[0]
+    L, N, pg, H, dh = k_pool.shape
+    d = H * dh
+    pos = cached_len + jnp.arange(Ts, dtype=jnp.int32)
+    x = params["emb"][tokens] + params["pos"][pos]          # (Ts, d)
+    flat = table[pos // page_size] * page_size + pos % page_size
+    lens1 = cached_len[None] if jnp.ndim(cached_len) == 0 else cached_len
+    for li, lp in enumerate(params["layers"]):
+        h = _ln(x, lp["ln1"])
+        q = (h @ lp["wq"]).reshape(Ts, H, dh)
+        k = (h @ lp["wk"]).reshape(Ts, H, dh)
+        v = (h @ lp["wv"]).reshape(Ts, H, dh)
+        k_pool = k_pool.at[li].set(
+            k_pool[li].reshape(N * pg, H, dh).at[flat].set(k)
+            .reshape(N, pg, H, dh))
+        v_pool = v_pool.at[li].set(
+            v_pool[li].reshape(N * pg, H, dh).at[flat].set(v)
+            .reshape(N, pg, H, dh))
+        a = paged_chunk_attention(q[None], k_pool[li], v_pool[li],
+                                  table[None], lens1)[0]
+        x = x + a.reshape(Ts, d) @ lp["wo"]
+        h2 = _ln(x, lp["ln2"])
+        x = x + jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
+    logits = _ln(x, params["ln_f"]) @ params["emb"].T
+    return logits, k_pool, v_pool
+
+
+@functools.partial(jax.jit, static_argnames=("heads", "page_size"))
+def _verify_step(params, k_pool, v_pool, tables, lens, tokens, *,
+                 heads, page_size):
+    """k tokens for every slot in one step (the speculative verify):
+    append all k K/V rows, attend with per-row causal offsets through
+    the chunked kernel.  Fixed-shape per (S, k) — compiled once."""
+    S, T = tokens.shape
+    L, N, pg, H, dh = k_pool.shape
+    d = H * dh
+    pos = lens[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # (S, T)
+    x = params["emb"][tokens] + params["pos"][pos]          # (S, T, d)
+    flat = (jnp.take_along_axis(tables, pos // page_size, axis=1)
+            * page_size + pos % page_size).reshape(-1)      # (S*T,)
+    for li, lp in enumerate(params["layers"]):
+        h = _ln(x, lp["ln1"])
+        q = (h @ lp["wq"]).reshape(S, T, H, dh)
+        k = (h @ lp["wk"]).reshape(S * T, H, dh)
+        v = (h @ lp["wv"]).reshape(S * T, H, dh)
+        k_pool = k_pool.at[li].set(
+            k_pool[li].reshape(N * pg, H, dh).at[flat].set(k)
+            .reshape(N, pg, H, dh))
+        v_pool = v_pool.at[li].set(
+            v_pool[li].reshape(N * pg, H, dh).at[flat].set(v)
+            .reshape(N, pg, H, dh))
+        a = paged_chunk_attention(q, k_pool[li], v_pool[li], tables, lens)
+        x = x + a.reshape(S, T, d) @ lp["wo"]
+        h2 = _ln(x, lp["ln2"])
+        x = x + jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
+    logits = _ln(x, params["ln_f"]) @ params["emb"].T
+    return logits, k_pool, v_pool
 
 
 @functools.partial(jax.jit, static_argnames=("heads", "page_size"))

@@ -23,6 +23,15 @@ _M_PAGE_ALLOCS = _metrics.counter(
     "decode_page_allocs_total", "pages handed out by the allocator")
 _M_PAGE_FREES = _metrics.counter(
     "decode_page_frees_total", "pages returned to the allocator free list")
+_M_PAGE_REFS = _metrics.gauge(
+    "decode_page_refs", "total references held on allocated pages "
+    "(> pages_in_use means copy-on-write sharing is active)")
+_M_PAGES_SHARED = _metrics.gauge(
+    "decode_pages_shared", "pages with refcount > 1 (aliased by forks, "
+    "beams, or the prefix cache)")
+_M_COW_COPIES = _metrics.counter(
+    "decode_cow_copies_total",
+    "shared pages copied before a write (copy-on-write splits)")
 
 
 class PoolExhausted(RuntimeError):
@@ -30,10 +39,19 @@ class PoolExhausted(RuntimeError):
 
 
 class PageAllocator:
-    """Free-list page allocator.  Pages are ints in [0, num_pages).
+    """Refcounted free-list page allocator.  Pages are ints in
+    [0, num_pages).
 
     Page 0 is reserved as the *null page*: inactive slots' page tables
     point at it, so a fixed-shape gather never indexes freed memory.
+
+    Sharing model (copy-on-write substrate): ``alloc`` hands out pages
+    at refcount 1; ``fork`` aliases an existing page run by bumping each
+    refcount (the forked sequence, beam sibling, or prefix-cache node
+    now co-owns the pages); ``free`` *releases* one reference per page
+    and only returns a page to the free list when its count hits zero.
+    A writer must check ``is_shared`` first and copy the page before
+    mutating it (see ``PagedPool.copy_page`` / the session's CoW step).
     """
 
     NULL_PAGE = 0
@@ -44,6 +62,7 @@ class PageAllocator:
         self.num_pages = int(num_pages)
         # LIFO free list: a just-freed (still-hot) page is reused first
         self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+        self._refs: dict = {}               # page -> live reference count
         self._in_use = 0
 
     @property
@@ -54,35 +73,79 @@ class PageAllocator:
     def pages_in_use(self) -> int:
         return self._in_use
 
+    @property
+    def total_refs(self) -> int:
+        return sum(self._refs.values())
+
+    @property
+    def pages_shared(self) -> int:
+        return sum(1 for c in self._refs.values() if c > 1)
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(int(page), 0)
+
+    def is_shared(self, page: int) -> bool:
+        return self._refs.get(int(page), 0) > 1
+
+    def _set_gauges(self) -> None:
+        _M_PAGES_IN_USE.set(self._in_use)
+        _M_PAGE_REFS.set(self.total_refs)
+        _M_PAGES_SHARED.set(self.pages_shared)
+
     def can_alloc(self, n: int) -> bool:
         return n <= len(self._free)
 
     def alloc(self, n: int) -> List[int]:
-        """Take ``n`` pages or raise ``PoolExhausted`` (taking none)."""
+        """Take ``n`` pages (each at refcount 1) or raise
+        ``PoolExhausted`` (taking none)."""
         if n > len(self._free):
             raise PoolExhausted(
                 f"page pool exhausted: need {n} pages, "
                 f"{len(self._free)} free of {self.num_pages - 1} usable")
         pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._refs[p] = 1
         self._in_use += n
         _M_PAGE_ALLOCS.inc(n)
-        _M_PAGES_IN_USE.set(self._in_use)
+        self._set_gauges()
         return pages
 
-    def free(self, pages: Sequence[int]) -> None:
-        seen = set(self._free)
+    def fork(self, pages: Sequence[int]) -> List[int]:
+        """Alias an existing page run: bump each page's refcount and
+        return the same ids as a fresh list the new owner may mutate
+        (list-structurally — the *pages* stay shared until CoW)."""
+        out = []
         for p in pages:
+            p = int(p)
+            if self._refs.get(p, 0) < 1:
+                raise ValueError(f"cannot fork unallocated page {p}")
+            self._refs[p] += 1
+            out.append(p)
+        self._set_gauges()
+        return out
+
+    def free(self, pages: Sequence[int]) -> List[int]:
+        """Release one reference per page; pages whose count hits zero
+        return to the free list.  Returns the ids actually freed.
+        Releasing a page with no live reference is the double-free
+        corruption and raises (covering duplicates inside one call
+        whenever they exceed the page's live count)."""
+        freed = []
+        for p in pages:
+            p = int(p)
             if p == self.NULL_PAGE:
                 raise ValueError("cannot free the reserved null page")
-            # `seen` grows within the call: a duplicate inside ONE
-            # free() is the same double-free corruption as across two
-            if p in seen or not (0 < p < self.num_pages):
+            if not (0 < p < self.num_pages) or self._refs.get(p, 0) < 1:
                 raise ValueError(f"double free / bad page id {p}")
-            seen.add(p)
-        self._free.extend(pages)
-        self._in_use -= len(pages)
-        _M_PAGE_FREES.inc(len(pages))
-        _M_PAGES_IN_USE.set(self._in_use)
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                del self._refs[p]
+                self._free.append(p)
+                self._in_use -= 1
+                freed.append(p)
+        _M_PAGE_FREES.inc(len(freed))
+        self._set_gauges()
+        return freed
 
 
 def _scatter_pages(pool, idx, buf):
@@ -91,6 +154,10 @@ def _scatter_pages(pool, idx, buf):
 
 def _scatter_row(pool, page, off, row):
     return pool.at[page, off].set(row)
+
+
+def _copy_page(pool, src, dst):
+    return pool.at[dst].set(pool[src])
 
 
 class PagedPool:
@@ -125,6 +192,7 @@ class PagedPool:
         # path (one write per admission / appended row).
         self._scatter = jax.jit(_scatter_pages)
         self._scatter_one = jax.jit(_scatter_row)
+        self._copy = jax.jit(_copy_page)
 
     def pages_for(self, length: int) -> int:
         """Pages needed to hold ``length`` rows."""
@@ -157,6 +225,11 @@ class PagedPool:
             self.data, np.int32(page), np.int32(off),
             np.asarray(row, self.data.dtype))
 
+    def copy_page(self, src: int, dst: int) -> None:
+        """Device copy of one page's rows (the CoW split)."""
+        self.data = self._copy(self.data, np.int32(src), np.int32(dst))
+        _M_COW_COPIES.inc()
+
     def page_table(self, pages: Sequence[int], width: int) -> np.ndarray:
         """Fixed-width page-table row, null-padded past the owned pages."""
         t = np.full((width,), PageAllocator.NULL_PAGE, np.int32)
@@ -188,7 +261,33 @@ def alloc_sequence(pool: PagedPool, length: int,
     return SequencePages(pages, length, pool.page_size)
 
 
+def fork_sequence(pool: PagedPool, seq: SequencePages) -> SequencePages:
+    """Alias ``seq``'s pages into a new SequencePages (refcounts bumped);
+    the fork diverges from its parent page-by-page via CoW writes."""
+    return SequencePages(pool.allocator.fork(seq.pages), seq.length,
+                         pool.page_size)
+
+
 def free_sequence(pool: PagedPool, seq: Optional[SequencePages]) -> None:
     if seq is not None and seq.pages:
         pool.allocator.free(seq.pages)
         seq.pages = []
+
+
+def cow_split(allocator: PageAllocator, pages: List[int], page_idx: int,
+              copiers) -> Optional[int]:
+    """Make ``pages[page_idx]`` private before a write: when shared,
+    allocate a fresh page, run each ``copier(src, dst)`` device copy,
+    release the shared original, and patch the page list in place.
+    Returns the new page id (or None when the page was already private).
+    Raises ``PoolExhausted`` without touching anything when no page is
+    free for the copy."""
+    old = pages[page_idx]
+    if not allocator.is_shared(old):
+        return None
+    (new,) = allocator.alloc(1)
+    for copy in copiers:
+        copy(old, new)
+    allocator.free([old])
+    pages[page_idx] = new
+    return new

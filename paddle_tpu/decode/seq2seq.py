@@ -36,6 +36,7 @@ class PagedSeq2SeqModel:
     """Adapt ``BeamGen`` + trained parameters to the DecodeSession."""
 
     grows_kv = False          # cross-attention context is static
+    emits_probs = True        # the step program ends in softmax
 
     def __init__(self, beam_gen, parameters, *, num_pages: int = 64,
                  page_size: int = 8, pages_per_seq: int = 2,
@@ -139,6 +140,11 @@ class PagedSeq2SeqModel:
 
     def pool_table(self, pages: Sequence[int]) -> np.ndarray:
         return self.pool.page_table(pages, self.pages_per_seq)
+
+    def copy_page(self, src: int, dst: int) -> None:
+        # static context is never written after prefill, so beams share
+        # encoder pages forever; the hook exists for contract parity
+        self.pool.copy_page(src, dst)
 
     def _padded_len(self, prompt) -> int:
         lens = [len(prompt[0])]

@@ -16,7 +16,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from paddle_tpu.lod import rewrap, unwrap
-from paddle_tpu.registry import register_op
+from paddle_tpu.registry import SkipInferShape, register_op
 
 
 @register_op("layer_norm", inputs=("X", "Scale", "Bias"),
@@ -41,7 +41,23 @@ def _layer_norm(ctx):
     ctx.set_output("Variance", var.squeeze(axes))
 
 
-@register_op("scaled_dot_product_attention", inputs=("Q", "K", "V"))
+def _infer_sdpa_shape(op, block):
+    # Out mirrors Q: (B, S, H, D) in, (B, S, H, D) out
+    qs = op.inputs.get("Q", [])
+    outs = op.outputs.get("Out", [])
+    if len(qs) != 1 or len(outs) != 1 or not qs[0] or not outs[0]:
+        raise SkipInferShape
+    qv, ov = block.find_var(qs[0]), block.find_var(outs[0])
+    if qv is None or ov is None or qv.shape is None:
+        raise SkipInferShape
+    if ov.shape is None:
+        ov.shape = tuple(qv.shape)
+    if ov.lod_level == 0 and qv.lod_level:
+        ov.lod_level = qv.lod_level
+
+
+@register_op("scaled_dot_product_attention", inputs=("Q", "K", "V"),
+             infer_shape=_infer_sdpa_shape)
 def _sdp_attention(ctx):
     """Q,K,V: (B, S, H, D) -> Out (B, S, H, D).
 

@@ -7,10 +7,28 @@ import jax.numpy as jnp
 from jax import lax
 
 from paddle_tpu.lod import unwrap
-from paddle_tpu.registry import register_op
+from paddle_tpu.registry import SkipInferShape, register_op
 
 
-@register_op("top_k", inputs=("X",), outputs=("Out", "Indices"), stop_gradient=True)
+def _infer_top_k_shape(op, block):
+    # Out/Indices: X with the last dim replaced by k
+    ins = op.inputs.get("X", [])
+    if len(ins) != 1 or not ins[0]:
+        raise SkipInferShape
+    xv = block.find_var(ins[0])
+    if xv is None or xv.shape is None or not xv.shape:
+        raise SkipInferShape
+    shape = tuple(xv.shape[:-1]) + (int(op.attr("k", 1)),)
+    for slot in ("Out", "Indices"):
+        outs = op.outputs.get(slot, [])
+        if len(outs) == 1 and outs[0]:
+            ov = block.find_var(outs[0])
+            if ov is not None and ov.shape is None:
+                ov.shape = shape
+
+
+@register_op("top_k", inputs=("X",), outputs=("Out", "Indices"), stop_gradient=True,
+             infer_shape=_infer_top_k_shape)
 def _top_k(ctx):
     x = unwrap(ctx.input("X"))
     k = ctx.attr("k", 1)

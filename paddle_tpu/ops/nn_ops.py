@@ -13,7 +13,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from paddle_tpu.lod import rewrap, unwrap
-from paddle_tpu.registry import SkipInferShape, register_op
+from paddle_tpu.registry import (SkipInferShape, infer_same_shape,
+                                 register_op)
 
 
 def _pref():
@@ -514,7 +515,7 @@ def _dropout(ctx):
     ctx.set_output("Mask", mask)
 
 
-@register_op("softmax", inputs=("X",))
+@register_op("softmax", inputs=("X",), infer_shape=infer_same_shape)
 def _softmax(ctx):
     unary_in = ctx.input("X")
     x = unwrap(unary_in)

@@ -97,6 +97,29 @@ def _infer_context_project_shape(op, block):
         last * int(ctx_len) if last >= 0 else -1,)
 
 
+def _infer_sequence_concat_shape(op, block):
+    """axis=1 (feature concat) is statically knowable; the temporal
+    axis=0 mode joins along a LoD-dynamic time dim and stays skipped."""
+    if op.attr("axis", 0) != 1:
+        raise SkipInferShape
+    ins = op.inputs.get("X", [])
+    outs = op.outputs.get("Out", [])
+    if not ins or len(outs) != 1 or not outs[0]:
+        raise SkipInferShape
+    xvs = [block.find_var(n) for n in ins if n]
+    ov = block.find_var(outs[0])
+    if len(xvs) != len(ins) or ov is None or any(
+            v is None or v.shape is None or len(v.shape) < 2 for v in xvs):
+        raise SkipInferShape
+    dims = [v.shape[1] for v in xvs]
+    base = list(xvs[0].shape)
+    base[1] = -1 if any(d < 0 for d in dims) else sum(dims)
+    if ov.shape is None:
+        ov.shape = tuple(base)
+    if ov.lod_level == 0 and xvs[0].lod_level:
+        ov.lod_level = xvs[0].lod_level
+
+
 def _seg_ids(x: LoDArray):
     off = x.last_level()
     return row_segment_ids(off, x.data.shape[0]), off.shape[0] - 1
@@ -192,7 +215,8 @@ def _temporal_concat_padded(a, la, b, lb):
     return jnp.where(from_a, ga, gb) * valid.astype(a.dtype)
 
 
-@register_op("sequence_concat", inputs=("X", "Length"))
+@register_op("sequence_concat", inputs=("X", "Length"),
+             infer_shape=_infer_sequence_concat_shape)
 def _sequence_concat(ctx):
     """Concat same-LoD inputs: axis=1 joins features, axis=0 joins each
     pair of sequences along *time* (reference: operators/

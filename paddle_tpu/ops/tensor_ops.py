@@ -12,7 +12,159 @@ import jax.numpy as jnp
 
 from paddle_tpu.lod import LoDArray, rewrap, unwrap
 from paddle_tpu.ops.common import jnp_dtype, unary
-from paddle_tpu.registry import infer_same_shape, register_op
+from paddle_tpu.registry import SkipInferShape, infer_same_shape, register_op
+
+
+# ---------------------------------------------------------------------------
+# infer_shape rules (registry-audit ratchet: tensor-movement / gather
+# family).  Same contract as the conv/pool rules in nn_ops.py: backfill
+# missing output metadata, SkipInferShape when statically unknowable.
+# ---------------------------------------------------------------------------
+
+
+def _shape_var(block, name):
+    v = block.find_var(name) if name else None
+    if v is None:
+        raise SkipInferShape
+    return v
+
+
+def _one_in_out(op, block, in_slot="X", out_slot="Out"):
+    ins = op.inputs.get(in_slot, [])
+    outs = op.outputs.get(out_slot, [])
+    if len(ins) != 1 or len(outs) != 1:
+        raise SkipInferShape
+    xv, ov = _shape_var(block, ins[0]), _shape_var(block, outs[0])
+    if xv.shape is None:
+        raise SkipInferShape
+    return xv, ov
+
+
+def _infer_concat_shape(op, block):
+    ins = op.inputs.get("X", [])
+    outs = op.outputs.get("Out", [])
+    if not ins or len(outs) != 1:
+        raise SkipInferShape
+    xvs = [_shape_var(block, n) for n in ins]
+    ov = _shape_var(block, outs[0])
+    if any(v.shape is None for v in xvs):
+        raise SkipInferShape
+    axis = op.attr("axis", 0) % max(1, len(xvs[0].shape))
+    base = list(xvs[0].shape)
+    if axis >= len(base):
+        raise SkipInferShape
+    dims = [v.shape[axis] if axis < len(v.shape) else -1 for v in xvs]
+    base[axis] = -1 if any(d < 0 for d in dims) else sum(dims)
+    if ov.shape is None:
+        ov.shape = tuple(base)
+    if ov.lod_level == 0 and xvs[0].lod_level:
+        ov.lod_level = xvs[0].lod_level
+
+
+def _infer_split_shape(op, block):
+    ins = op.inputs.get("X", [])
+    outs = op.outputs.get("Out", [])
+    if len(ins) != 1 or not outs:
+        raise SkipInferShape
+    xv = _shape_var(block, ins[0])
+    if xv.shape is None or not xv.shape:
+        raise SkipInferShape
+    axis = op.attr("axis", 0) % len(xv.shape)
+    sections = op.attr("sections", None)
+    if sections and len(sections) != len(outs):
+        raise SkipInferShape
+    for i, name in enumerate(outs):
+        ov = _shape_var(block, name)
+        if ov.shape is not None:
+            continue
+        if sections:
+            d = int(sections[i])
+        elif xv.shape[axis] >= 0:
+            d = xv.shape[axis] // max(1, len(outs))
+        else:
+            d = -1
+        shape = list(xv.shape)
+        shape[axis] = d
+        ov.shape = tuple(shape)
+
+
+def _infer_reshape_shape(op, block):
+    xv, ov = _one_in_out(op, block)
+    if ov.shape is not None:
+        return
+    shape = [int(s) for s in (op.attr("shape", ()) or ())]
+    if not shape:
+        raise SkipInferShape
+    shape = [xv.shape[i] if s == 0 and i < len(xv.shape) else s
+             for i, s in enumerate(shape)]
+    if shape.count(-1) == 1 and all(d >= 0 for d in xv.shape):
+        total = 1
+        for d in xv.shape:
+            total *= d
+        known = 1
+        for d in shape:
+            if d > 0:
+                known *= d
+        if known > 0 and total % known == 0:
+            shape[shape.index(-1)] = total // known
+    ov.shape = tuple(shape)
+
+
+def _infer_transpose_shape(op, block):
+    xv, ov = _one_in_out(op, block)
+    perm = op.attr("axis", None)
+    if not perm or len(perm) != len(xv.shape):
+        raise SkipInferShape
+    if ov.shape is None:
+        ov.shape = tuple(xv.shape[int(p)] for p in perm)
+
+
+def _infer_expand_shape(op, block):
+    xv, ov = _one_in_out(op, block)
+    times = op.attr("expand_times", None)
+    # only the matched-rank tile; rank-promoting tiles stay dynamic
+    if not times or len(times) != len(xv.shape):
+        raise SkipInferShape
+    if ov.shape is None:
+        ov.shape = tuple(d * int(t) if d >= 0 else -1
+                         for d, t in zip(xv.shape, times))
+
+
+def _infer_gather_shape(op, block):
+    xv, ov = _one_in_out(op, block)
+    idxs = op.inputs.get("Index", [])
+    if len(idxs) != 1:
+        raise SkipInferShape
+    iv = _shape_var(block, idxs[0])
+    if iv.shape is None:
+        raise SkipInferShape
+    if ov.shape is None:
+        # jnp.take(x, idx, axis=0): idx dims replace x's leading dim
+        ov.shape = tuple(iv.shape) + tuple(xv.shape[1:])
+
+
+def _infer_scatter_shape(op, block):
+    rv, ov = _one_in_out(op, block, "Ref", "Out")
+    if ov.shape is None:
+        ov.shape = tuple(rv.shape)
+
+
+def _infer_shape_op_shape(op, block):
+    xv, ov = _one_in_out(op, block, "Input", "Out")
+    if ov.shape is None:
+        ov.shape = (len(xv.shape),)
+
+
+def _infer_one_hot_shape(op, block):
+    xv, ov = _one_in_out(op, block)
+    depth = op.attr("depth", None)
+    if not depth:
+        raise SkipInferShape
+    if ov.shape is None:
+        shape = tuple(xv.shape)
+        if shape and shape[-1] == 1:   # trailing id dim is squeezed
+            shape = shape[:-1]
+        ov.shape = shape + (int(depth),)
 
 
 @register_op("fill_constant", inputs=(), stop_gradient=True)
@@ -76,7 +228,7 @@ def _increment(ctx):
     unary(ctx, lambda x: x + jnp.asarray(step, x.dtype))
 
 
-@register_op("concat", inputs=("X",))
+@register_op("concat", inputs=("X",), infer_shape=_infer_concat_shape)
 def _concat(ctx):
     xs = ctx.inputs("X")
     axis = ctx.attr("axis", 0)
@@ -84,7 +236,7 @@ def _concat(ctx):
     ctx.set_output("Out", rewrap(xs[0], jnp.concatenate(datas, axis=axis)))
 
 
-@register_op("split", inputs=("X",))
+@register_op("split", inputs=("X",), infer_shape=_infer_split_shape)
 def _split(ctx):
     x = unwrap(ctx.input("X"))
     axis = ctx.attr("axis", 0)
@@ -102,7 +254,7 @@ def _split(ctx):
     ctx.set_outputs("Out", parts)
 
 
-@register_op("reshape", inputs=("X",))
+@register_op("reshape", inputs=("X",), infer_shape=_infer_reshape_shape)
 def _reshape(ctx):
     x = unwrap(ctx.input("X"))
     shape = list(ctx.attr("shape"))
@@ -113,27 +265,31 @@ def _reshape(ctx):
     ctx.set_output("Out", jnp.reshape(x, shape))
 
 
-@register_op("transpose", inputs=("X",))
+@register_op("transpose", inputs=("X",),
+             infer_shape=_infer_transpose_shape)
 def _transpose(ctx):
     x = unwrap(ctx.input("X"))
     ctx.set_output("Out", jnp.transpose(x, ctx.attr("axis")))
 
 
-@register_op("expand", inputs=("X",))
+@register_op("expand", inputs=("X",), infer_shape=_infer_expand_shape)
 def _expand(ctx):
     x = unwrap(ctx.input("X"))
     times = ctx.attr("expand_times")
     ctx.set_output("Out", jnp.tile(x, times))
 
 
-@register_op("gather", inputs=("X", "Index"), diff_inputs=("X",))
+@register_op("gather", inputs=("X", "Index"), diff_inputs=("X",),
+             infer_shape=_infer_gather_shape)
 def _gather(ctx):
     x = unwrap(ctx.input("X"))
     idx = unwrap(ctx.input("Index")).astype(jnp.int32)
     ctx.set_output("Out", jnp.take(x, idx, axis=0))
 
 
-@register_op("scatter", inputs=("Ref", "Index", "Updates"), diff_inputs=("Ref", "Updates"))
+@register_op("scatter", inputs=("Ref", "Index", "Updates"),
+             diff_inputs=("Ref", "Updates"),
+             infer_shape=_infer_scatter_shape)
 def _scatter(ctx):
     ref = unwrap(ctx.input("Ref"))
     idx = unwrap(ctx.input("Index")).astype(jnp.int32)
@@ -197,7 +353,8 @@ def _lookup_table(ctx):
     ctx.set_output("Out", rewrap(ids, out))
 
 
-@register_op("shape", inputs=("Input",), stop_gradient=True)
+@register_op("shape", inputs=("Input",), stop_gradient=True,
+             infer_shape=_infer_shape_op_shape)
 def _shape(ctx):
     x = unwrap(ctx.input("Input"))
     ctx.set_output("Out", jnp.asarray(x.shape, dtype=jnp.int32))
@@ -215,7 +372,8 @@ def _slice_tensor(ctx):
     ctx.set_output("Out", x[tuple(sl)])
 
 
-@register_op("one_hot", inputs=("X",), stop_gradient=True)
+@register_op("one_hot", inputs=("X",), stop_gradient=True,
+             infer_shape=_infer_one_hot_shape)
 def _one_hot(ctx):
     x = unwrap(ctx.input("X")).astype(jnp.int32)
     if x.ndim and x.shape[-1] == 1:
@@ -224,7 +382,7 @@ def _one_hot(ctx):
     ctx.set_output("Out", jax.nn.one_hot(x, depth, dtype=jnp.float32))
 
 
-@register_op("reverse", inputs=("X",))
+@register_op("reverse", inputs=("X",), infer_shape=infer_same_shape)
 def _reverse(ctx):
     """Flip along `axis` (reference capability: RotateLayer's flip half;
     fluid gained a reverse op in later versions)."""

@@ -34,16 +34,23 @@ Endpoints:
                            Unknown payload keys (other than ``@len``
                            side-feeds) are a 400 naming the key.
   POST /generate         → body {"src": [int ids], "max_new_tokens": N,
-                           "stream": bool} against a paged-KV decode
-                           engine (paddle_tpu/decode).  With
-                           ``stream`` (default true) the reply is
+                           "stream": bool, "beam": k, "temperature":
+                           t, "top_k": k, "seed": s} against a
+                           paged-KV decode engine (paddle_tpu/decode).
+                           With ``stream`` (default true) the reply is
                            chunked ndjson — one ``{"token": t}`` line
                            per generated token as the continuous-
                            batching session emits it, then a final
                            ``{"done": true, "ids": [...],
                            "finish_reason": ...}`` line; without it,
                            one JSON object after generation finishes.
-                           Page-pool exhaustion / full admission queue
+                           ``beam`` (when the engine allows it) runs
+                           beam search over copy-on-write sibling
+                           slots and replies non-streamed with the
+                           full ``"beams"`` list best-first;
+                           ``temperature``/``top_k``/``seed`` switch
+                           the slot to seeded sampling.  Page-pool
+                           exhaustion / full admission queue
                            → 503 (admission refusal, live sequences
                            unaffected); request deadline → 504.
 
@@ -282,24 +289,46 @@ class InferenceServer:
                         raise ValueError(
                             "'src' must be a non-empty list of int ids")
                     unknown = set(payload) - {"src", "max_new_tokens",
-                                              "stream"}
+                                              "stream", "beam",
+                                              "temperature", "top_k",
+                                              "seed"}
                     if unknown:
                         raise ValueError(
                             f"unknown payload key {sorted(unknown)[0]!r}; "
-                            "expected src / max_new_tokens / stream")
+                            "expected src / max_new_tokens / stream / "
+                            "beam / temperature / top_k / seed")
                     budget = payload.get("max_new_tokens")
+                    beam = payload.get("beam")
                     deadline = (time.monotonic() + server._request_timeout
                                 if server._request_timeout else None)
-                    if payload.get("stream", True):
-                        self._stream_generate(src, budget, deadline)
+                    # grace past the deadline: the session itself
+                    # expires the request and reports it
+                    timeout = (None if deadline is None else
+                               max(0.0, deadline - time.monotonic())
+                               + 30.0)
+                    if beam is not None:
+                        if (not isinstance(beam, int) or beam < 1
+                                or isinstance(beam, bool)):
+                            raise ValueError(
+                                "'beam' must be a positive int")
+                        req = server._generator.submit_beam(
+                            src, beam_size=beam,
+                            max_new_tokens=budget, deadline=deadline)
+                        ids = req.result(timeout)
+                        self._reply(200, {
+                            "ids": ids,
+                            "beams": [{"score": s, "ids": t}
+                                      for s, t in (req.beams or [])],
+                            "finish_reason": req.finish_reason})
+                    elif payload.get("stream", True):
+                        self._stream_generate(src, budget, deadline,
+                                              payload)
                     else:
-                        req = server._generator.submit(src, budget,
-                                                       deadline=deadline)
-                        # grace past the deadline: the session itself
-                        # expires the request and reports it
-                        timeout = (None if deadline is None else
-                                   max(0.0, deadline - time.monotonic())
-                                   + 30.0)
+                        req = server._generator.submit(
+                            src, budget, deadline=deadline,
+                            temperature=payload.get("temperature"),
+                            top_k=payload.get("top_k"),
+                            seed=payload.get("seed"))
                         ids = req.result(timeout)
                         self._reply(200, {
                             "ids": ids,
@@ -325,7 +354,8 @@ class InferenceServer:
                     _EVENTS.complete("serving.generate", ev_t0, dt,
                                      cat="serving")
 
-            def _stream_generate(self, src, budget, deadline) -> None:
+            def _stream_generate(self, src, budget, deadline,
+                                 payload=None) -> None:
                 """Chunked ndjson: one line per token as the decode
                 session emits it, then the summary line.  Admission
                 refusals (503) and pre-stream deadline expiry (504)
@@ -334,9 +364,12 @@ class InferenceServer:
                 ``finish_reason: "deadline"`` (the status is already
                 on the wire)."""
                 q: queue_mod.Queue = queue_mod.Queue()
-                req = server._generator.submit(src, budget,
-                                               on_token=q.put,
-                                               deadline=deadline)
+                payload = payload or {}
+                req = server._generator.submit(
+                    src, budget, on_token=q.put, deadline=deadline,
+                    temperature=payload.get("temperature"),
+                    top_k=payload.get("top_k"),
+                    seed=payload.get("seed"))
                 if deadline is not None:
                     # hold the 200 until the stream actually starts:
                     # a request that dies of its deadline before its

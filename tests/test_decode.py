@@ -164,7 +164,7 @@ def nmt_world():
     oracle = SequenceGenerator(_make_beam_gen(), params)
     engine = GenerationEngine.for_seq2seq(
         _make_beam_gen(), params, num_pages=24, page_size=8,
-        pages_per_seq=2, max_slots=3, max_new_tokens=7)
+        pages_per_seq=2, max_slots=3, max_new_tokens=7, beam_max=3)
     yield oracle, engine
     engine.stop()
 
@@ -357,12 +357,33 @@ def test_generate_endpoint_streams_oracle_tokens(gen_server):
     assert "decode_pages_in_use" in metrics
 
 
+def test_generate_endpoint_beam_matches_oracle(gen_server):
+    oracle, srv = gen_server
+    src = [3, 9, 5, 6]
+    want = oracle.generate([src], beam_size=2)
+
+    code, body = _gen_post(srv.address, {"src": src, "beam": 2})
+    assert code == 200
+    doc = json.loads(body)
+    assert doc["ids"] == want[0][1]
+    got = [(b["score"], b["ids"]) for b in doc["beams"]]
+    assert [t for _, t in got] == [t for _, t in want]
+    for (gs, _), (ws, _) in zip(got, want):
+        assert abs(gs - ws) < 1e-5
+
+
 def test_generate_endpoint_rejects_bad_payloads(gen_server):
     oracle, srv = gen_server
     code, body = _gen_post(srv.address, {"src": "nope"})
     assert code == 400
-    code, body = _gen_post(srv.address, {"src": [1], "beam": 2})
+    code, body = _gen_post(srv.address, {"src": [1], "nucleus": 2})
+    assert code == 400 and b"nucleus" in body
+    code, body = _gen_post(srv.address, {"src": [1], "beam": 0})
     assert code == 400 and b"beam" in body
+    # beam wider than the engine cap -> 503 admission refusal
+    code, body = _gen_post(srv.address, {"src": [1], "beam": 4})
+    assert code == 503
+    assert json.loads(body)["reason"] == "beam_too_wide"
     # too-long prompt -> 503 admission refusal with the reason
     code, body = _gen_post(srv.address,
                            {"src": list(range(2, 12)) + [2] * 7,

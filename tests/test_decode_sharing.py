@@ -1,0 +1,376 @@
+"""Shared-KV generation (ISSUE 18): copy-on-write page refcounts,
+prefix caching, speculative decoding, and beam search over sibling
+slots.
+
+The allocator invariants are fuzzed against a pure-python model; every
+decode-path test checks token parity against a dense oracle AND that
+the page pool is fully recovered afterwards (the double-free /
+leaked-page class of bug is the whole risk of refcounted sharing).
+"""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.decode.paged_kv import PageAllocator, PagedPool, cow_split
+
+
+# ---------------------------------------------------------------------------
+# allocator refcount invariants (property/fuzz)
+# ---------------------------------------------------------------------------
+
+
+def test_alloc_fork_free_refcounts():
+    a = PageAllocator(8)
+    pages = a.alloc(3)
+    assert all(a.refcount(p) == 1 for p in pages)
+    assert a.pages_in_use == 3 and a.total_refs == 3
+
+    forked = a.fork(pages)
+    assert forked == pages                  # fork aliases, never copies
+    assert a.pages_in_use == 3              # no new memory
+    assert a.total_refs == 6
+    assert all(a.is_shared(p) for p in pages)
+    assert a.pages_shared == 3
+
+    # first free only drops refs; pages stay allocated
+    assert a.free(forked) == []
+    assert a.pages_in_use == 3 and a.pages_shared == 0
+    # second free actually releases
+    assert sorted(a.free(pages)) == sorted(pages)
+    assert a.pages_in_use == 0 and a.free_pages == 7
+
+
+def test_free_unreferenced_page_raises():
+    a = PageAllocator(8)
+    (p,) = a.alloc(1)
+    a.free([p])
+    with pytest.raises(ValueError):
+        a.free([p])
+    with pytest.raises(ValueError):
+        a.free([0])                          # reserved null page
+
+
+def test_cow_split_copies_shared_only():
+    a = PageAllocator(8)
+    pages = a.alloc(2)
+    # private page: no copy, returns None
+    assert cow_split(a, list(pages), 0, []) is None
+
+    forked = a.fork(pages)
+    mine = list(pages)
+    copies = []
+    new = cow_split(a, mine, 1, [lambda s, d: copies.append((s, d))])
+    assert new is not None and new != pages[1]
+    assert mine[1] == new and copies == [(pages[1], new)]
+    assert a.refcount(pages[1]) == 1         # the other holder keeps it
+    assert a.refcount(new) == 1
+    a.free(mine)
+    a.free(forked)
+    assert a.pages_in_use == 0
+
+
+def test_allocator_refcount_fuzz():
+    """Random admit/fork/cow-write/free against a reference model: no
+    page is ever double-freed or leaked, shared pages are never
+    released early, and the pool is fully recovered at the end."""
+    rng = np.random.RandomState(0)
+    a = PageAllocator(32)
+    seqs = []                               # each: list of page ids
+
+    def model_refs():
+        refs = {}
+        for s in seqs:
+            for p in s:
+                refs[p] = refs.get(p, 0) + 1
+        return refs
+
+    for _ in range(2000):
+        op = rng.randint(4)
+        if op == 0 and a.can_alloc(3):                       # admit
+            seqs.append(a.alloc(int(rng.randint(1, 4))))
+        elif op == 1 and seqs:                               # fork
+            seqs.append(a.fork(seqs[rng.randint(len(seqs))]))
+        elif op == 2 and seqs:                               # CoW write
+            s = seqs[rng.randint(len(seqs))]
+            i = int(rng.randint(len(s)))
+            if a.is_shared(s[i]) and a.can_alloc(1):
+                old = s[i]
+                new = cow_split(a, s, i, [])
+                assert new is not None and s[i] == new
+                assert a.refcount(new) == 1
+                assert a.refcount(old) == model_refs().get(old)
+        elif op == 3 and seqs:                               # evict
+            before = model_refs()
+            s = seqs.pop(rng.randint(len(seqs)))
+            freed = a.free(s)
+            # only pages whose last reference this was came back
+            for p in set(s):
+                expected_gone = before[p] == s.count(p)
+                assert (p in freed) == expected_gone
+        # global invariants, every step
+        refs = model_refs()
+        assert a.pages_in_use == len(refs)
+        assert a.total_refs == sum(refs.values())
+        assert a.pages_in_use + a.free_pages == 31           # page 0 reserved
+        for p, n in refs.items():
+            assert a.refcount(p) == n
+
+    for s in seqs:
+        a.free(s)
+    assert a.pages_in_use == 0 and a.free_pages == 31
+
+
+def test_pool_copy_page_copies_rows():
+    pool = PagedPool(num_pages=4, page_size=2, feature_shape=(2, 4))
+    src, dst = pool.allocator.alloc(2)
+    rows = np.arange(2 * 2 * 4, dtype=np.float32).reshape(2, 2, 4)
+    pool.write_rows([src], rows)
+    pool.copy_page(src, dst)
+    np.testing.assert_array_equal(np.asarray(pool.data[dst]),
+                                  np.asarray(pool.data[src]))
+    np.testing.assert_array_equal(np.asarray(pool.data[src]), rows)
+
+
+# ---------------------------------------------------------------------------
+# LM fixtures: one tiny decoder shared per module
+# ---------------------------------------------------------------------------
+
+
+PROMPT = [1, 5, 9, 3, 7, 2, 8, 4, 6, 2, 3]
+
+
+def _mk(seed=3, **kw):
+    from paddle_tpu.decode.model import TinyDecoderLM
+
+    kw.setdefault("num_pages", 64)
+    return TinyDecoderLM(seed=seed, **kw)
+
+
+# ---------------------------------------------------------------------------
+# prefix cache
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_cache_parity_hits_and_pool_recovery():
+    from paddle_tpu.decode.prefix import PrefixCache
+    from paddle_tpu.decode.session import DecodeRequest, DecodeSession
+
+    m = _mk()
+    cache = PrefixCache(m.allocator, m.page_size, capacity_pages=16)
+    sess = DecodeSession(m, max_slots=4, prefix_cache=cache)
+    oracle = m.dense_greedy(PROMPT, 8)
+
+    r1 = DecodeRequest(list(PROMPT), max_new_tokens=8)
+    sess.submit(r1)
+    sess.run(200)
+    assert r1.result(5) == oracle
+    assert cache.misses == 1 and cache.hits == 0
+    assert cache.cached_pages == 1          # 11 tokens, ps=8 -> 1 full page
+
+    r2 = DecodeRequest(list(PROMPT), max_new_tokens=8)
+    sess.submit(r2)
+    sess.run(200)
+    assert r2.result(5) == oracle           # cached prefill == full prefill
+    assert cache.hits == 1
+
+    # longer prompt sharing page 0: still exact parity
+    p3 = list(PROMPT[:8]) + [4, 4, 1, 3, 9, 9, 2, 5, 6]
+    o3 = m.dense_greedy(p3, 6)
+    r3 = DecodeRequest(list(p3), max_new_tokens=6)
+    sess.submit(r3)
+    sess.run(200)
+    assert r3.result(5) == o3
+    assert cache.hits == 2
+    # all pages either free or retained by the cache — none leaked
+    assert m.allocator.pages_in_use == cache.cached_pages
+
+
+def test_prefix_cache_capacity_eviction():
+    from paddle_tpu.decode.prefix import PrefixCache
+
+    m = _mk()
+    cache = PrefixCache(m.allocator, m.page_size, capacity_pages=2)
+    rng = np.random.RandomState(5)
+    for _ in range(4):                      # 4 distinct 2-page prefixes
+        prompt = [int(t) for t in rng.randint(2, 40, 17)]
+        pages = m.allocator.alloc(2)
+        cache.insert(prompt, pages)
+        m.allocator.free(pages)             # cache holds its own refs
+    assert cache.cached_pages <= 2
+    assert cache.stats()["evictions"] >= 2
+    cache.clear()
+    assert m.allocator.pages_in_use == 0
+
+
+def test_prefix_cache_evict_for_pages_only_drops_sole_refs():
+    from paddle_tpu.decode.prefix import PrefixCache
+
+    m = _mk(num_pages=8)
+    cache = PrefixCache(m.allocator, m.page_size, capacity_pages=6)
+    prompt = [int(t) for t in np.arange(2, 2 + 16)]
+    pages = m.allocator.alloc(2)
+    cache.insert(prompt, pages)
+    # a live sequence still aliases these pages: memory-pressure
+    # eviction must NOT reclaim them
+    assert cache.evict_for_pages(2) == 0
+    m.allocator.free(pages)                 # live sequence goes away
+    assert cache.evict_for_pages(2) == 2
+    assert m.allocator.pages_in_use == 0
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding
+# ---------------------------------------------------------------------------
+
+
+def test_spec_decode_token_identity_standalone():
+    from paddle_tpu.decode.spec import (ModelDraft, NgramDraft,
+                                        SpeculativeDecoder)
+
+    m = _mk()
+    oracle = m.dense_greedy(PROMPT, 12)
+    # low-acceptance draft: prompt-lookup n-grams
+    got = SpeculativeDecoder(m, NgramDraft(), k=4).generate(PROMPT, 12)
+    assert got == oracle
+    assert m.allocator.pages_in_use == 0
+    # perfect draft (same weights): high acceptance, same tokens
+    got = SpeculativeDecoder(m, ModelDraft(_mk()), k=4).generate(PROMPT, 12)
+    assert got == oracle
+    assert m.allocator.pages_in_use == 0
+
+
+def test_spec_decode_token_identity_in_session():
+    from paddle_tpu.decode.session import DecodeRequest, DecodeSession
+    from paddle_tpu.decode.spec import NgramDraft
+
+    m = _mk(seed=5)
+    prompts = [PROMPT, [2, 3, 4, 5, 6], [9, 8, 7, 1, 2, 3, 4]]
+    oracles = [m.dense_greedy(p, 10) for p in prompts]
+    sess = DecodeSession(m, max_slots=4, spec_draft=NgramDraft(), spec_k=4)
+    reqs = [DecodeRequest(list(p), max_new_tokens=10) for p in prompts]
+    for r in reqs:
+        sess.submit(r)
+    sess.run(500)
+    for r, want in zip(reqs, oracles):
+        assert r.result(5) == want
+    assert m.allocator.pages_in_use == 0
+
+
+def test_spec_session_refuses_sampling_and_beam():
+    from paddle_tpu.decode.session import (AdmissionRefused, BeamRequest,
+                                           DecodeRequest, DecodeSession)
+    from paddle_tpu.decode.spec import NgramDraft
+
+    sess = DecodeSession(_mk(), max_slots=2, spec_draft=NgramDraft())
+    with pytest.raises(AdmissionRefused) as e:
+        sess.submit(DecodeRequest([1, 2], max_new_tokens=4, temperature=0.7,
+                                  seed=1))
+    assert e.value.reason == "spec_mode"
+    with pytest.raises(AdmissionRefused):
+        sess.submit(BeamRequest([1, 2], beam_size=2, max_new_tokens=4))
+
+
+def test_accept_greedy_rule():
+    from paddle_tpu.decode.spec import accept_greedy
+
+    # target agrees with the whole draft: all accepted + bonus token
+    emitted, acc = accept_greedy([7, 8, 9], [7, 8, 9, 4])
+    assert emitted == [7, 8, 9, 4] and acc == 3
+    # first disagreement truncates; target's correction is emitted
+    emitted, acc = accept_greedy([7, 5, 9], [7, 8, 9, 4])
+    assert emitted == [7, 8] and acc == 1
+    emitted, acc = accept_greedy([5, 5, 5], [7, 8, 9, 4])
+    assert emitted == [7] and acc == 0
+
+
+# ---------------------------------------------------------------------------
+# beam search through the session
+# ---------------------------------------------------------------------------
+
+
+def test_lm_beam_size_one_matches_greedy():
+    from paddle_tpu.decode.session import BeamRequest, DecodeSession
+
+    m = _mk(seed=7)
+    greedy = m.dense_greedy(PROMPT, 8)
+    sess = DecodeSession(m, max_slots=4)
+    req = BeamRequest(list(PROMPT), beam_size=1, max_new_tokens=8)
+    sess.submit(req)
+    sess.run(300)
+    req.wait(5)
+    assert req.tokens == greedy
+    assert m.allocator.pages_in_use == 0
+
+
+def test_lm_beam_returns_sorted_beams_and_frees_pages():
+    from paddle_tpu.decode.session import BeamRequest, DecodeSession
+
+    m = _mk(seed=7)
+    sess = DecodeSession(m, max_slots=4)
+    req = BeamRequest(list(PROMPT), beam_size=3, max_new_tokens=8)
+    sess.submit(req)
+    sess.run(300)
+    req.wait(5)
+    assert req.beams and len(req.beams) <= 3
+    scores = [s for s, _ in req.beams]
+    assert scores == sorted(scores, reverse=True)
+    assert req.tokens == req.beams[0][1]
+    assert m.allocator.pages_in_use == 0
+
+
+def test_seq2seq_beam_matches_dense_oracle():
+    """CoW sibling-slot beam == the dense SequenceGenerator beam oracle,
+    exactly — scores and tokens — on the NMT demo network."""
+    from demos.seq2seq.gen_config import make_beam_gen
+    from paddle_tpu.decode.engine import GenerationEngine
+    from paddle_tpu.executor import Scope
+    from paddle_tpu.generation import SequenceGenerator
+
+    class _Params:
+        def __init__(self):
+            self.scope = Scope()
+
+    params = _Params()
+    oracle = SequenceGenerator(make_beam_gen(beam_size=1, max_length=7),
+                               params)
+    engine = GenerationEngine.for_seq2seq(
+        make_beam_gen(beam_size=1, max_length=7), params, num_pages=24,
+        page_size=8, pages_per_seq=2, max_slots=4, max_new_tokens=7,
+        beam_max=4)
+    try:
+        for k in (1, 2, 3):
+            for src in ([4, 7, 2], [3, 9, 5, 6]):
+                want = oracle.generate([src], beam_size=k)
+                req = engine.submit_beam(src, beam_size=k)
+                req.wait(300)
+                got = req.beams
+                assert got is not None, (src, k, req.finish_reason)
+                assert [t for _, t in got] == [t for _, t in want]
+                for (gs, _), (ws, _) in zip(got, want):
+                    assert abs(gs - ws) < 1e-5
+        assert engine.model.allocator.pages_in_use == 0
+    finally:
+        engine.stop()
+
+
+# ---------------------------------------------------------------------------
+# per-slot seeded sampling
+# ---------------------------------------------------------------------------
+
+
+def test_sampling_seed_determinism():
+    from paddle_tpu.decode.session import DecodeRequest, DecodeSession
+
+    m = _mk(seed=11)
+    sess = DecodeSession(m, max_slots=2)
+
+    def run(seed):
+        r = DecodeRequest(list(PROMPT), max_new_tokens=8,
+                          temperature=0.9, top_k=5, seed=seed)
+        sess.submit(r)
+        sess.run(300)
+        r.wait(5)
+        return list(r.tokens)
+
+    assert run(42) == run(42)               # same seed, same tokens
+    assert m.allocator.pages_in_use == 0

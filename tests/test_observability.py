@@ -6,6 +6,7 @@ surface, `paddle stats`, Chrome-trace export, and the satellite fixes
 import io
 import json
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -387,9 +388,17 @@ def test_metrics_endpoint_on_live_server(tmp_path, capsys):
         _predict(base, xs)
         _predict(base, xs)
 
-        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
-            ctype = r.headers["Content-Type"]
-            text = r.read().decode()
+        # the latency observation lands in the handler's ``finally``
+        # *after* the reply is on the wire — give the scrape a moment
+        # to see both requests settle
+        want = 'serving_request_seconds_count{endpoint="/predict"} 2'
+        for _ in range(100):
+            with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+                ctype = r.headers["Content-Type"]
+                text = r.read().decode()
+            if want in text:
+                break
+            time.sleep(0.05)
         assert ctype.startswith("text/plain")
         assert "# TYPE serving_request_seconds histogram" in text
         assert 'serving_request_seconds_bucket{endpoint="/predict",le="+Inf"} 2' in text
@@ -439,7 +448,13 @@ def test_metrics_under_concurrent_load(tmp_path):
         for t in threads:
             t.join()
         assert not errs
+        # handler threads observe the histogram after replying — wait
+        # for the last observations to settle
         lat = obs.REGISTRY.get("serving_request_seconds")
+        for _ in range(100):
+            if lat.count(endpoint="/predict") >= 21:
+                break
+            time.sleep(0.05)
         assert lat.count(endpoint="/predict") == 21
         resp = obs.REGISTRY.get("serving_responses_total")
         assert resp.value(code="200") == 21
