@@ -102,7 +102,12 @@ class PrefixCache:
         cached_len) where ``pages`` are *forked* (one new reference each,
         owned by the caller) and ``cached_len = len(pages) * page_size``.
         Caps at ``len(prompt) - 1`` tokens so the admitting prefill
-        always computes the final prompt token's logits."""
+        always computes the final prompt token's logits.
+
+        Stats are NOT counted here: admission may still fail (pool full
+        -> pages freed, request requeued, re-matched next tick), so the
+        caller reports the outcome via ``commit_match`` once the
+        prefill actually ran."""
         self._clock += 1
         node_map = self._root
         run: List[_Node] = []
@@ -116,16 +121,21 @@ class PrefixCache:
         # re-stamp ancestors too: a hit deep in the trie keeps the whole
         # path hot, so LRU cannot evict a parent before its children
         if run:
+            pages = self.allocator.fork([n.page for n in run])
+            return pages, len(pages) * self.page_size
+        return [], 0
+
+    def commit_match(self, cached_len: int) -> None:
+        """Record the outcome of a ``match`` whose admission committed
+        (the prefill ran with ``cached_len`` tokens skipped)."""
+        if cached_len > 0:
             self.hits += 1
             _M_HIT.inc()
-            pages = self.allocator.fork([n.page for n in run])
-            saved = len(pages) * self.page_size
-            self.tokens_saved += saved
-            _M_SAVED.inc(saved)
-            return pages, saved
-        self.misses += 1
-        _M_MISS.inc()
-        return [], 0
+            self.tokens_saved += cached_len
+            _M_SAVED.inc(cached_len)
+        else:
+            self.misses += 1
+            _M_MISS.inc()
 
     def insert(self, prompt: Sequence[int], pages: Sequence[int]) -> int:
         """Retain the prompt's full pages: ``pages[i]`` must hold the
@@ -135,12 +145,17 @@ class PrefixCache:
         self._clock += 1
         node_map = self._root
         parent: Optional[_Node] = None
+        path_ids: set = set()          # nodes the walk already crossed
         added = 0
         for i, chunk in enumerate(self._chunks(prompt, len(prompt))):
             node = node_map.get(chunk)
             if node is None:
+                # eviction must never pick a node on this insertion
+                # path: dropping the just-walked parent would attach
+                # the new child to a detached subtree, leaking its page
                 if (self._size >= self.capacity_pages
-                        and not self._evict_lru(1, require_sole=False)):
+                        and not self._evict_lru(1, require_sole=False,
+                                                exclude=path_ids)):
                     break
                 self.allocator.fork([pages[i]])
                 node = _Node(chunk, pages[i], parent)
@@ -149,6 +164,7 @@ class PrefixCache:
                 added += 1
             node.stamp = self._clock
             parent = node
+            path_ids.add(id(node))
             node_map = node.children
         _M_CACHED.set(self._size)
         return added
@@ -172,11 +188,13 @@ class PrefixCache:
         self.allocator.free([node.page])
         self._size -= 1
 
-    def _evict_lru(self, count: int, require_sole: bool) -> int:
+    def _evict_lru(self, count: int, require_sole: bool,
+                   exclude: Optional[set] = None) -> int:
         """Drop up to ``count`` LRU leaf nodes.  With ``require_sole``,
         only nodes whose page has no other owner qualify (eviction must
         actually return memory); without it, any leaf qualifies (the
-        capacity bound trims the trie even when slots still share)."""
+        capacity bound trims the trie even when slots still share).
+        ``exclude`` (node ids) protects an in-flight insertion path."""
         cause = "memory" if require_sole else "capacity"
         dropped = 0
         while dropped < count:
@@ -184,6 +202,8 @@ class PrefixCache:
             if require_sole:
                 leaves = [n for n in leaves
                           if self.allocator.refcount(n.page) == 1]
+            if exclude:
+                leaves = [n for n in leaves if id(n) not in exclude]
             if not leaves:
                 break
             victim = min(leaves, key=lambda n: n.stamp)

@@ -74,7 +74,8 @@ class DecodeRequest:
     temperature scales the next-token distribution (0/None = greedy
     argmax), top_k keeps only the k most likely tokens, and seed pins
     the slot's own RNG so a request replays bit-identically regardless
-    of what else shares the batch."""
+    of what else shares the batch.  top_k/seed without temperature is
+    rejected (ValueError) rather than silently decoded greedily."""
 
     def __init__(self, prompt, max_new_tokens: int = 32,
                  on_token: Optional[Callable[[int], None]] = None,
@@ -86,6 +87,10 @@ class DecodeRequest:
         self.max_new_tokens = int(max_new_tokens)
         self.on_token = on_token
         self.deadline = deadline            # time.monotonic timestamp
+        if (top_k or seed is not None) and not temperature:
+            raise ValueError(
+                "top_k/seed require temperature > 0; without it decoding "
+                "is greedy argmax and they would be silently ignored")
         self.temperature = (None if not temperature
                             else float(temperature))
         self.top_k = None if not top_k else int(top_k)
@@ -453,7 +458,16 @@ class DecodeSession:
         sibling slots — each surviving hypothesis forks its parent's
         pages (CoW) and inherits its states; dropped hypotheses release
         theirs."""
-        sel = beam_select(np.asarray(dist, np.float64), g.scores,
+        dist = np.asarray(dist, np.float64)
+        if not getattr(self.model, "emits_probs", False):
+            # beam_select scores log-probabilities: raw logits must be
+            # softmaxed per row first (mirrors _choose), or every
+            # negative logit clamps to the same log floor and the
+            # rankings are garbage
+            dist = dist - dist.max(axis=-1, keepdims=True)
+            dist = np.exp(dist)
+            dist = dist / dist.sum(axis=-1, keepdims=True)
+        sel = beam_select(dist, g.scores,
                           g.alive, g.seqs, self.model.eos_id, g.k)
         if sel is None:
             self._finish_group(g, "eos")
@@ -657,6 +671,10 @@ class DecodeSession:
             raise
         _M_PREFILL_SEC.observe(time.perf_counter() - t0)
         if self._prefix is not None:
+            # stats only count now that the admission committed — a
+            # requeued request re-matches every retry and must not
+            # inflate hits/tokens_saved for prefills that never ran
+            self._prefix.commit_match(cached_len)
             self._prefix.insert(req.prompt, pages)
         return pages, ctx_len, state_rows, first_logits
 

@@ -49,7 +49,9 @@ Endpoints:
                            slots and replies non-streamed with the
                            full ``"beams"`` list best-first;
                            ``temperature``/``top_k``/``seed`` switch
-                           the slot to seeded sampling.  Page-pool
+                           the slot to seeded sampling (top_k/seed
+                           without temperature → 400, never silently
+                           greedy).  Page-pool
                            exhaustion / full admission queue
                            → 503 (admission refusal, live sequences
                            unaffected); request deadline → 504.

@@ -202,6 +202,51 @@ def test_prefix_cache_capacity_eviction():
     assert m.allocator.pages_in_use == 0
 
 
+def test_prefix_insert_never_evicts_its_own_path():
+    """Single-chain trie at capacity: making room for a child must not
+    evict the just-walked parent — the old behavior attached the child
+    to a detached subtree, leaking its page forever."""
+    from paddle_tpu.decode.prefix import PrefixCache
+
+    m = _mk()
+    cache = PrefixCache(m.allocator, m.page_size, capacity_pages=1)
+    prompt = [int(t) for t in np.arange(2, 2 + 16)]   # 2 full pages
+    pages = m.allocator.alloc(2)
+    cache.insert(prompt, pages)
+    m.allocator.free(pages)
+    assert cache.cached_pages == 1          # second chunk refused, not leaked
+    cache.clear()
+    assert cache.cached_pages == 0
+    assert m.allocator.pages_in_use == 0    # nothing unreachable holds a page
+
+
+def test_prefix_cache_stats_count_only_committed_admissions():
+    """match() forks pages but must not count hits/tokens_saved — a
+    requeued admission re-matches every retry; stats land only when the
+    caller commits the outcome after the prefill ran."""
+    from paddle_tpu.decode.prefix import PrefixCache
+
+    m = _mk()
+    cache = PrefixCache(m.allocator, m.page_size, capacity_pages=4)
+    prompt = [int(t) for t in np.arange(2, 2 + 17)]   # 2 full pages + 1
+    pages = m.allocator.alloc(3)
+    cache.insert(prompt, pages)
+    m.allocator.free(pages)
+
+    forked, saved = cache.match(prompt)
+    assert saved == 16 and len(forked) == 2
+    assert cache.hits == 0 and cache.misses == 0
+    assert cache.tokens_saved == 0          # nothing committed yet
+    m.allocator.free(forked)                # admission failed -> retry later
+
+    cache.commit_match(saved)
+    assert cache.hits == 1 and cache.tokens_saved == 16
+    cache.commit_match(0)
+    assert cache.misses == 1
+    cache.clear()
+    assert m.allocator.pages_in_use == 0
+
+
 def test_prefix_cache_evict_for_pages_only_drops_sole_refs():
     from paddle_tpu.decode.prefix import PrefixCache
 
@@ -302,6 +347,47 @@ def test_lm_beam_size_one_matches_greedy():
     assert m.allocator.pages_in_use == 0
 
 
+class _ShiftedLogits:
+    """Delegates to a TinyDecoderLM but shifts every logit strictly
+    negative — a softmax/argmax no-op, so greedy is unchanged, while
+    the broken beam scoring (log(max(logits, 1e-20)) on raw logits)
+    would clamp every token to one floor value."""
+
+    def __init__(self, inner, shift=-1e4):
+        self._inner = inner
+        self._shift = shift
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def prefill(self, prompt, pages, **kw):
+        ctx, states, logits = self._inner.prefill(prompt, pages, **kw)
+        return ctx, states, np.asarray(logits) + self._shift
+
+    def decode(self, tokens, states, tables, lens):
+        logits, st = self._inner.decode(tokens, states, tables, lens)
+        return np.asarray(logits) + self._shift, st
+
+
+def test_lm_beam_negative_logits_matches_greedy():
+    """emits_probs=False models hand the beam raw logits: the session
+    must softmax them before beam_select, so beam_size=1 equals greedy
+    even when every logit is negative and scores stay finite log-probs."""
+    from paddle_tpu.decode.session import BeamRequest, DecodeSession
+
+    m = _mk(seed=7)
+    greedy = m.dense_greedy(PROMPT, 8)
+    sess = DecodeSession(_ShiftedLogits(m), max_slots=4)
+    req = BeamRequest(list(PROMPT), beam_size=1, max_new_tokens=8)
+    sess.submit(req)
+    sess.run(300)
+    req.wait(5)
+    assert req.tokens == greedy
+    # proper per-token log-probs, not k * log(1e-20) floor garbage
+    assert req.beams and req.beams[0][0] > 8 * np.log(1e-20) / 2
+    assert m.allocator.pages_in_use == 0
+
+
 def test_lm_beam_returns_sorted_beams_and_frees_pages():
     from paddle_tpu.decode.session import BeamRequest, DecodeSession
 
@@ -356,6 +442,21 @@ def test_seq2seq_beam_matches_dense_oracle():
 # ---------------------------------------------------------------------------
 # per-slot seeded sampling
 # ---------------------------------------------------------------------------
+
+
+def test_sampling_params_require_temperature():
+    """top_k/seed without temperature would be silently ignored (greedy
+    argmax); the request constructor rejects the combination so serving
+    returns a 400 instead."""
+    from paddle_tpu.decode.session import DecodeRequest
+
+    with pytest.raises(ValueError):
+        DecodeRequest([1, 2], max_new_tokens=4, top_k=5)
+    with pytest.raises(ValueError):
+        DecodeRequest([1, 2], max_new_tokens=4, seed=7)
+    r = DecodeRequest([1, 2], max_new_tokens=4, temperature=0.5,
+                      top_k=5, seed=7)
+    assert r.top_k == 5 and r.seed == 7
 
 
 def test_sampling_seed_determinism():
