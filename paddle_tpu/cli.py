@@ -209,6 +209,9 @@ def cmd_serve(argv):
     """paddle serve [--model_dir=DIR] [--port=N] [--replicas=N]
     [--max_batch=N] [--batch_timeout_ms=MS] [--warmup]
     [--request_timeout=S] [--max_inflight=N]
+    [--tenants=NAME:RATE[:BURST[:WEIGHT]],...] [--tenant_config=FILE]
+    [--max_attempts=N] [--replica_heartbeat_ms=MS]
+    [--dispatch_timeout=S] [--chaos=KIND[@N[:rIDX]]]
     [--gen_config=SCRIPT --gen_pages=N --gen_page_size=N
      --gen_pages_per_seq=N --gen_slots=N --gen_queue=N
      --gen_max_tokens=N --beam_max=K --prefix_cache
@@ -217,7 +220,14 @@ def cmd_serve(argv):
     export (paddle_tpu/serving): concurrent requests coalesce into
     power-of-two batch buckets dispatched across a pool of executor
     replicas, with graceful-degradation bounds (504 on deadline expiry,
-    503 on overload).  With --gen_config, also mounts POST /generate —
+    503 on overload).  Replicas are supervised and self-healing:
+    crashed or hung dispatches requeue their batch (up to
+    --max_attempts per request) onto a respawned replica.  --tenants
+    gives each named tenant a token-bucket admission quota and a
+    fair-queue weight ('*' entry templates unknown tenants;
+    --tenant_config reads the same spec, one entry per line, from a
+    file); --chaos arms a dev-only fault injector (die|raise|hang on
+    the Nth dispatch).  With --gen_config, also mounts POST /generate —
     token streaming over the paged-KV continuous-batching decode
     engine (paddle_tpu/decode); --beam_max enables {"beam": k} beam
     search, --prefix_cache shares prompt-prefix KV pages across
@@ -232,6 +242,14 @@ def cmd_serve(argv):
               "[--gen_config=SCRIPT ...] (need --model_dir and/or "
               "--gen_config)", file=sys.stderr)
         return 2
+    def _tenant_spec(a):
+        if a.get("tenant_config"):
+            with open(a["tenant_config"]) as fh:
+                entries = [ln.strip() for ln in fh
+                           if ln.strip() and not ln.startswith("#")]
+            return ",".join(entries)
+        return a.get("tenants")
+
     return _serve(
         lambda a: InferenceServer(
             a.get("model_dir"), port=int(a.get("port", 0)),
@@ -243,6 +261,13 @@ def cmd_serve(argv):
             max_batch=int(a.get("max_batch", 8)),
             batch_timeout_ms=float(a.get("batch_timeout_ms", 0.0)),
             warmup="--warmup" in rest,
+            tenants=_tenant_spec(a),
+            max_attempts=int(a.get("max_attempts", 3)),
+            replica_heartbeat_ms=float(a.get("replica_heartbeat_ms",
+                                             1000.0)),
+            dispatch_timeout=(float(a["dispatch_timeout"])
+                              if a.get("dispatch_timeout") else None),
+            chaos=a.get("chaos"),
             generator=(_load_generator(a, rest) if a.get("gen_config")
                        else None)),
         argv, "inference server")

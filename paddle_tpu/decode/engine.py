@@ -123,6 +123,13 @@ class GenerationEngine:
         self._wake.set()
         return req
 
+    def cancel(self, req: DecodeRequest) -> None:
+        """Abandon a request whose consumer is gone (dead streaming
+        socket): flags it and nudges the stepper, which evicts the slot
+        and frees its pages at the next tick."""
+        req.cancel()
+        self._wake.set()
+
     # -- introspection ------------------------------------------------------
 
     def info(self) -> dict:

@@ -55,6 +55,13 @@ _M_TTFT = _metrics.histogram(
     "decode_ttft_seconds", "submit-to-first-token latency per sequence")
 _M_REQ_SEC = _metrics.histogram(
     "decode_request_seconds", "submit-to-finish latency per sequence")
+_M_STEP_FAIL = _metrics.counter(
+    "decode_step_failures_total",
+    "decode/verify dispatches that raised (contained per-slot, "
+    "stepper survives)")
+_M_CANCELLED = _metrics.counter(
+    "decode_cancelled_total",
+    "generation requests cancelled by their consumer (pages freed)")
 
 
 class AdmissionRefused(RuntimeError):
@@ -100,6 +107,8 @@ class DecodeRequest:
         self.finish_reason: Optional[str] = None   # eos|length|deadline|error
         self.submitted_at = time.monotonic()
         self.first_token_at: Optional[float] = None
+        self.step_failures = 0         # decode steps that died under us
+        self.cancelled = False         # consumer gone; evict next tick
         self._done = threading.Event()
 
     # -- waiter side --------------------------------------------------------
@@ -143,6 +152,12 @@ class DecodeRequest:
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline
+
+    def cancel(self) -> None:
+        """Consumer-side abandon (disconnected stream): flag the
+        request; the stepper evicts the slot and frees its pages at the
+        next tick (never cross-thread surgery on live slot state)."""
+        self.cancelled = True
 
 
 class BeamRequest(DecodeRequest):
@@ -323,7 +338,12 @@ class DecodeSession:
     def step(self) -> int:
         """One tick: admit -> decode -> evict.  Returns the number of
         slots that were active during the decode dispatch (0 = idle,
-        nothing dispatched)."""
+        nothing dispatched).  A decode dispatch that *raises* is
+        contained (``_contain_step_failure``): the slots that were in
+        the batch are evicted — first offense requeued to retry from
+        scratch, second offense quarantined with 503 ``step_failed`` —
+        and the stepper thread lives on."""
+        self._sweep_cancelled()
         self._admit()
         active_idx = [i for i, s in enumerate(self._slots) if s is not None]
         if not active_idx:
@@ -342,8 +362,12 @@ class DecodeSession:
             if not active_idx:
                 return 0
         t0 = time.perf_counter()
-        logits, new_states = self.model.decode(
-            self._tokens, self._states, self._tables, self._lens)
+        try:
+            logits, new_states = self.model.decode(
+                self._tokens, self._states, self._tables, self._lens)
+        except BaseException as exc:  # noqa: BLE001 - contained per slot
+            self._contain_step_failure(active_idx, exc)
+            return len(active_idx)
         _M_STEP_SEC.observe(time.perf_counter() - t0)
         _M_STEPS.inc()
         logits = np.asarray(logits)
@@ -569,8 +593,12 @@ class DecodeSession:
         if not active_idx:
             return 0
         t0 = time.perf_counter()
-        logits, new_states = self.model.verify_chunk(
-            tokens, self._states, self._tables, self._lens)
+        try:
+            logits, new_states = self.model.verify_chunk(
+                tokens, self._states, self._tables, self._lens)
+        except BaseException as exc:  # noqa: BLE001 - contained per slot
+            self._contain_step_failure(active_idx, exc)
+            return len(active_idx)
         _M_STEP_SEC.observe(time.perf_counter() - t0)
         _M_STEPS.inc()
         logits = np.asarray(logits)                     # (S, k, V)
@@ -609,6 +637,82 @@ class DecodeSession:
             self._evict(i, "length")
         else:
             self._tokens[i, 0] = tok
+
+    def _contain_step_failure(self, active_idx: List[int],
+                              exc: BaseException) -> None:
+        """A decode/verify dispatch raised.  One fused step covers every
+        live slot, so the offender can't be attributed from here — every
+        slot that was in the batch is a suspect.  First offense: the
+        slot is evicted and its request requeued to retry from a fresh
+        prefill (innocent batchmates lose only latency).  Second
+        offense: the request has now killed two dispatches and is
+        quarantined with 503 ``step_failed`` — the decode-plane mirror
+        of the replica pool's poison-batch rule.  Queued requests and
+        the stepper thread are untouched."""
+        _M_STEP_FAIL.inc()
+        requeue: List[DecodeRequest] = []
+        groups_seen = set()
+        for i in list(active_idx):
+            slot = self._slots[i]
+            if slot is None:
+                continue
+            if slot.group is not None:
+                g = slot.group
+                if id(g) in groups_seen:
+                    continue
+                groups_seen.add(id(g))
+                # beam hypotheses share one request: no per-member
+                # retry semantics, the group fails as a unit
+                self._finish_group(g, "error", AdmissionRefused(
+                    "step_failed",
+                    f"decode step failed with this beam in the batch: "
+                    f"{type(exc).__name__}: {exc}"))
+                continue
+            req = slot.req
+            req.step_failures += 1
+            if req.step_failures >= 2:
+                self._evict(i, "error", AdmissionRefused(
+                    "step_failed",
+                    f"decode step failed {req.step_failures} times with "
+                    f"this request in the batch; quarantined "
+                    f"({type(exc).__name__}: {exc})"))
+                continue
+            # evict without finishing: the request restarts from an
+            # empty generation at its next admission
+            self._slots[i] = None
+            self._tables[i] = 0
+            self._lens[i] = 1
+            self._tokens[i, 0] = self.model.bos_id
+            if slot.pages:
+                self.model.allocator.free(slot.pages)
+                slot.pages = []
+            req.tokens = []
+            requeue.append(req)
+        if requeue:
+            with self._lock:
+                self._pending[0:0] = requeue
+                _M_WAITING.set(len(self._pending))
+        _M_ACTIVE.set(self.active)
+
+    def _sweep_cancelled(self) -> None:
+        """Evict slots whose consumer abandoned them (dead streaming
+        socket) and drop cancelled waiters — pages and queue capacity
+        come back immediately instead of after max_new_tokens."""
+        for i, slot in enumerate(self._slots):
+            if (slot is not None and slot.req.cancelled
+                    and not slot.req.done):
+                _M_CANCELLED.inc()
+                self._evict(i, "cancelled")
+        with self._lock:
+            live, dead = [], []
+            for req in self._pending:
+                (dead if req.cancelled else live).append(req)
+            if dead:
+                self._pending = live
+                _M_WAITING.set(len(live))
+        for req in dead:
+            _M_CANCELLED.inc()
+            req._finish("cancelled")
 
     def _sweep_expired(self) -> None:
         """Fail queued requests whose deadline passed.  Runs every tick

@@ -58,14 +58,32 @@ class RetryPolicy:
     def with_(self, **kw) -> "RetryPolicy":
         return replace(self, **kw)
 
-    def delays(self, rng: Optional[random.Random] = None) -> Iterator[float]:
-        """Backoff delay before attempt 2, 3, ... (max_attempts-1 values)."""
+    def for_attempt(self, n: int,
+                    rng: Optional[random.Random] = None) -> float:
+        """Backoff delay after failure ``n`` (0-based: ``for_attempt(0)``
+        is the sleep before the second try), without the
+        ``retry_call`` wrapper — the serving replica supervisor and the
+        requeue path use this to pace restarts they drive themselves.
+
+        The undithered delay is ``min(base_delay * multiplier**n,
+        max_delay)``; with ``jitter`` j the returned value is uniform in
+        ``[d * (1 - j), d * (1 + j)]`` (then floored at 0), so j=0.25
+        means +/-25% of the computed delay — enough spread that a fleet
+        retrying the same dead service doesn't reconnect in lockstep,
+        while the expected delay stays exactly ``d``.
+        """
         rng = rng or random
+        d = min(self.base_delay * (self.multiplier ** max(int(n), 0)),
+                self.max_delay)
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
+        return max(d, 0.0)
+
+    def delays(self, rng: Optional[random.Random] = None) -> Iterator[float]:
+        """Backoff delay before attempt 2, 3, ... (max_attempts-1
+        values); each value is ``for_attempt(i)`` for i = 0, 1, ..."""
         for i in range(max(self.max_attempts - 1, 0)):
-            d = min(self.base_delay * (self.multiplier ** i), self.max_delay)
-            if self.jitter:
-                d *= 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
-            yield max(d, 0.0)
+            yield self.for_attempt(i, rng)
 
 
 #: Policy used by the RPC clients unless the caller overrides it: five

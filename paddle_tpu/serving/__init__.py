@@ -62,14 +62,34 @@ Graceful degradation (bounded, not unbounded thread pileup):
   - ``request_timeout``: per-request deadline — a request that does not
     complete before it expires returns 504 (and is dropped from the
     queue without burning a dispatch if it expires while queued);
-  - clients that disconnect mid-response are counted, not crashed.
+  - clients that disconnect mid-response are counted, not crashed; a
+    client that abandons a ``/generate`` stream mid-flight gets its
+    decode slot cancelled (pages freed) instead of generating to a
+    dead socket.
   All are counted in ``serving_rejected_total{reason=...}`` on
   ``/metrics`` (overload → 503, deadline → 504, client_gone).
+
+Self-healing & multi-tenancy (PR 19):
+  - requests carry a tenant id (``X-Tenant`` header or ``"tenant"``
+    payload key; absent → ``"default"``); per-tenant token buckets
+    turn one tenant's burst into *their* 429 ``tenant_over_quota``
+    instead of everyone's 503, and weighted-fair dequeue keeps heavy
+    tenants from starving light ones;
+  - replicas are supervised: a dispatch that raises or outlives its
+    lease marks the replica dead, its in-flight batch is requeued
+    (bounded ``attempts``; a poison request is quarantined with 503
+    ``retry_exhausted``), and a fresh replica is respawned with
+    backoff under a restart-rate limit;
+  - sustained pressure past ``shed_watermark`` sheds lowest-weight
+    tenants first; ``/health`` flips to ``"degraded"`` with reasons
+    while the pool is down replicas or shedding.
 
 Launch:  paddle serve --model_dir=DIR [--port=N]
                       [--replicas=N] [--max_batch=N]
                       [--batch_timeout_ms=MS] [--warmup]
                       [--request_timeout=SECONDS] [--max_inflight=N]
+                      [--tenants=SPEC] [--max_attempts=N]
+                      [--replica_heartbeat_ms=MS] [--chaos=KIND@N]
 """
 
 from __future__ import annotations
@@ -86,18 +106,29 @@ import numpy as np
 from paddle_tpu.observability import metrics as _metrics
 from paddle_tpu.observability.events import GLOBAL_EVENTS as _EVENTS
 from paddle_tpu.serving.batching import (
+    DEFAULT_TENANT,
     BatchSpec,
     PendingRequest,
+    QueueShed,
     RequestQueue,
+    RetryExhausted,
+    TenantOverQuota,
+    TenantRegistry,
     bucket_ladder,
     next_bucket,
 )
-from paddle_tpu.serving.replica import ModelBundle, Replica, ReplicaPool
+from paddle_tpu.serving.replica import (
+    FaultInjector,
+    ModelBundle,
+    Replica,
+    ReplicaPool,
+)
 
 __all__ = [
-    "BatchSpec", "InferenceServer", "ModelBundle", "PendingRequest",
-    "Replica", "ReplicaPool", "RequestQueue", "bucket_ladder",
-    "next_bucket",
+    "BatchSpec", "FaultInjector", "InferenceServer", "ModelBundle",
+    "PendingRequest", "QueueShed", "Replica", "ReplicaPool",
+    "RequestQueue", "RetryExhausted", "TenantOverQuota",
+    "TenantRegistry", "bucket_ladder", "next_bucket",
 ]
 
 _M_REQ_SEC = _metrics.histogram(
@@ -129,7 +160,11 @@ class InferenceServer:
                  request_timeout: float = None, max_inflight: int = None,
                  replicas: int = 1, max_batch: int = 8,
                  batch_timeout_ms: float = 0.0, warmup: bool = False,
-                 generator=None, place=None):
+                 generator=None, place=None, tenants=None,
+                 max_attempts: int = 3,
+                 replica_heartbeat_ms: float = 1000.0,
+                 dispatch_timeout: float = None, chaos=None,
+                 shed_watermark: int = None):
         if model_dir is None and generator is None:
             raise ValueError("need a model_dir to predict from and/or a "
                              "generator (paddle_tpu.decode."
@@ -149,10 +184,25 @@ class InferenceServer:
             self._spec = BatchSpec.disabled(
                 "coalescing off (max_batch <= 1): every request runs at "
                 "its exact feed shape", code="coalescing_off")
+        if isinstance(tenants, str):
+            tenants = TenantRegistry.parse(tenants)
+        self._tenants = tenants if tenants is not None else TenantRegistry()
+        if shed_watermark is None:
+            # deep enough that normal bursts never shed, shallow enough
+            # that a collapsing pool rejects instead of queueing forever
+            shed_watermark = max(64, 8 * max_batch)
+        self.fault = (FaultInjector.from_spec(chaos)
+                      if isinstance(chaos, str) else chaos)
         self._queue = RequestQueue(max_batch=max_batch,
-                                   batch_timeout=batch_timeout_ms / 1000.0)
+                                   batch_timeout=batch_timeout_ms / 1000.0,
+                                   tenants=self._tenants,
+                                   shed_watermark=shed_watermark)
         self._pool = (ReplicaPool(self._bundle, self._queue, self._spec,
-                                  replicas=replicas, place=place)
+                                  replicas=replicas, place=place,
+                                  fault=self.fault,
+                                  max_attempts=max_attempts,
+                                  heartbeat=replica_heartbeat_ms / 1000.0,
+                                  dispatch_timeout=dispatch_timeout)
                       if self._bundle else None)
         self._request_timeout = request_timeout
         self._max_inflight = max_inflight
@@ -160,6 +210,11 @@ class InferenceServer:
                        if max_inflight else None)
         if warmup and self._pool is not None:
             self._pool.warmup()
+        if isinstance(chaos, str) and self.fault is not None:
+            # spec-string chaos is the operator path (--chaos=die@1):
+            # nobody else can arm it, so arm now — after warmup, so the
+            # nth dispatch counts live traffic, not compile traffic
+            self.fault.arm()
 
         server = self
 
@@ -189,8 +244,11 @@ class InferenceServer:
 
             def do_GET(self):
                 if self.path == "/health":
+                    reasons = server.degraded_reasons()
                     self._reply(200, {
-                        "status": "ok",
+                        "status": "degraded" if reasons else "ok",
+                        "reasons": reasons,
+                        "self_healing": server.self_healing_info(),
                         "feeds": server.feed_names,
                         "fetches": [getattr(f, "name", str(f))
                                     for f in server._fetches],
@@ -237,13 +295,33 @@ class InferenceServer:
                 _M_INFLIGHT.inc()
                 ev_t0 = _EVENTS.now()
                 t0 = time.perf_counter()
+                tenant = (self.headers.get("X-Tenant")
+                          or DEFAULT_TENANT).strip() or DEFAULT_TENANT
                 try:
                     payload = json.loads(raw_body or b"{}")
+                    if isinstance(payload, dict) and "tenant" in payload:
+                        tenant = str(payload.pop("tenant")) or tenant
                     deadline = (time.monotonic() + server._request_timeout
                                 if server._request_timeout else None)
-                    outs = server.predict(payload, deadline=deadline)
+                    outs = server.predict(payload, deadline=deadline,
+                                          tenant=tenant)
                     self._reply(200, {"outputs": [_jsonable(o)
                                                   for o in outs]})
+                except TenantOverQuota as e:
+                    _M_REJECTED.inc(reason="tenant_over_quota",
+                                    tenant=e.tenant)
+                    self._reply(429, {"error": str(e),
+                                      "reason": "tenant_over_quota",
+                                      "tenant": e.tenant})
+                except QueueShed as e:
+                    _M_REJECTED.inc(reason=e.reason, tenant=tenant)
+                    self._reply(503, {"error": str(e),
+                                      "reason": e.reason})
+                except RetryExhausted as e:
+                    _M_REJECTED.inc(reason="retry_exhausted",
+                                    tenant=tenant)
+                    self._reply(503, {"error": str(e),
+                                      "reason": "retry_exhausted"})
                 except TimeoutError as e:
                     _M_REJECTED.inc(reason="deadline")
                     self._reply(504, {"error": str(e)})
@@ -280,11 +358,15 @@ class InferenceServer:
                 _M_INFLIGHT.inc()
                 ev_t0 = _EVENTS.now()
                 t0 = time.perf_counter()
+                tenant = (self.headers.get("X-Tenant")
+                          or DEFAULT_TENANT).strip() or DEFAULT_TENANT
                 try:
                     payload = json.loads(raw_body or b"{}")
                     if not isinstance(payload, dict):
                         raise ValueError(
                             "request body must be a JSON object")
+                    if "tenant" in payload:
+                        tenant = str(payload.pop("tenant")) or tenant
                     src = payload.get("src")
                     if (not isinstance(src, list) or not src
                             or not all(isinstance(t, int) for t in src)):
@@ -298,7 +380,10 @@ class InferenceServer:
                         raise ValueError(
                             f"unknown payload key {sorted(unknown)[0]!r}; "
                             "expected src / max_new_tokens / stream / "
-                            "beam / temperature / top_k / seed")
+                            "beam / temperature / top_k / seed / tenant")
+                    # same token buckets as /predict: a generation call
+                    # spends one admission token for its tenant
+                    server._tenants.admit(tenant)
                     budget = payload.get("max_new_tokens")
                     beam = payload.get("beam")
                     deadline = (time.monotonic() + server._request_timeout
@@ -335,6 +420,12 @@ class InferenceServer:
                         self._reply(200, {
                             "ids": ids,
                             "finish_reason": req.finish_reason})
+                except TenantOverQuota as e:
+                    _M_REJECTED.inc(reason="tenant_over_quota",
+                                    tenant=e.tenant)
+                    self._reply(429, {"error": str(e),
+                                      "reason": "tenant_over_quota",
+                                      "tenant": e.tenant})
                 except AdmissionRefused as e:
                     _M_REJECTED.inc(reason=e.reason)
                     self._reply(503, {"error": str(e),
@@ -405,8 +496,10 @@ class InferenceServer:
                     self._chunk(final)
                     self.wfile.write(b"0\r\n\r\n")
                 except (BrokenPipeError, ConnectionResetError):
-                    # the consumer left; the session still finishes the
-                    # sequence (its slot frees naturally) — count it
+                    # the consumer left: cancel the decode slot so its
+                    # pages free now instead of generating the rest of
+                    # the sequence into a dead socket
+                    server._generator.cancel(req)
                     _M_REJECTED.inc(reason="client_gone")
                     self.close_connection = True
 
@@ -425,6 +518,25 @@ class InferenceServer:
     @property
     def port(self):
         return self._httpd.server_address[1]
+
+    def degraded_reasons(self) -> list:
+        """Machine-readable reasons /health is ``degraded`` (empty =
+        healthy): dead replicas, exhausted restart budget, active load
+        shedding."""
+        reasons = []
+        if self._pool is not None:
+            reasons.extend(self._pool.degraded_reasons())
+        deg = self._queue.degradation()
+        if deg.get("shedding"):
+            reasons.append(f"load_shedding:{deg['shedding']}")
+        return reasons
+
+    def self_healing_info(self) -> dict:
+        return {
+            "pool": self._pool.info() if self._pool else None,
+            "tenants": self._tenants.info(),
+            "queue": self._queue.degradation(),
+        }
 
     def batching_info(self) -> dict:
         return {
@@ -459,11 +571,14 @@ class InferenceServer:
                     "side-feeds)")
         return feed
 
-    def predict(self, payload: dict, deadline: float = None):
+    def predict(self, payload: dict, deadline: float = None,
+                tenant: str = DEFAULT_TENANT):
         """Run one request through the batching engine.  ``deadline``
         (a ``time.monotonic`` timestamp) bounds the *whole* wait —
         queueing and execution; an expired request raises TimeoutError
-        (504 over HTTP) instead of stacking up behind busy replicas."""
+        (504 over HTTP) instead of stacking up behind busy replicas.
+        ``tenant`` selects the admission token bucket and fair-queue
+        weight (429/503 raised here as TenantOverQuota/QueueShed)."""
         if self._bundle is None:
             raise ValueError("this server mounts no inference export "
                              "(generation-only; POST /generate instead)")
@@ -476,11 +591,12 @@ class InferenceServer:
             reason = (self._spec.code if not self._spec.batchable
                       else "shape_mismatch")
             req = PendingRequest(feed, rows=1, batchable=False,
-                                 deadline=deadline, solo_reason=reason)
+                                 deadline=deadline, solo_reason=reason,
+                                 tenant=tenant)
         else:
             rows, cast = info
             req = PendingRequest(cast, rows=rows, batchable=True,
-                                 deadline=deadline)
+                                 deadline=deadline, tenant=tenant)
         self._queue.submit(req)
         timeout = None
         if deadline is not None:

@@ -54,7 +54,8 @@ if command -v ruff >/dev/null 2>&1; then
         paddle_tpu/distributed/elastic.py paddle_tpu/distributed/retry.py \
         paddle_tpu/serving/ paddle_tpu/decode/ \
         paddle_tpu/pallas/tuning/ \
-        benchmark/serving_bench.py benchmark/decode_bench.py
+        benchmark/serving_bench.py benchmark/decode_bench.py \
+        benchmark/serving_chaos_bench.py
 else
     echo "ruff not installed; skipping style pass"
 fi
@@ -67,6 +68,18 @@ import json
 doc = json.load(open("/tmp/serving_bench_smoke.json"))
 assert doc["schema"] == "paddle_tpu.serving_bench.v1", doc["schema"]
 assert doc["configs"], "no bench configs recorded"
+EOF
+
+echo "== serving_chaos_bench: smoke (kill a replica mid-burst, zero lost)"
+python benchmark/serving_chaos_bench.py --smoke \
+    --out /tmp/serving_chaos_smoke.json > /dev/null
+python - <<'EOF'
+import json
+doc = json.load(open("/tmp/serving_chaos_smoke.json"))
+assert doc["schema"] == "paddle_tpu.serving_chaos.v1", doc["schema"]
+assert doc["smoke"]["lost"] == 0, doc["smoke"]
+assert doc["smoke"]["replica_killed"], "fault injector never fired"
+assert doc["smoke"]["restarts"] >= 1, doc["smoke"]
 EOF
 
 echo "== decode_bench: smoke (paged decode engine + artifact writer)"
