@@ -1,0 +1,153 @@
+"""`paddle compile`: export AOT serving artifacts.
+
+Usage:
+  paddle compile --model_dir=DIR --out=DIR [--max_batch=N]
+                 [--buckets=1,2,4] [--no-optimize]
+                 [--gen_config=SCRIPT [--gen_*=...]]
+  paddle compile --smoke
+
+Runs the serving warmup paths under export capture (paddle_tpu/aot):
+every bucket-ladder program (and, with --gen_config, every decode-step
+program one synthetic generation compiles) is lowered AOT, serialized,
+and pinned in a versioned manifest.  `paddle serve --artifacts=DIR`
+then boots replicas from the store instead of JIT-compiling.
+
+--smoke is the self-contained CI gate: build a throwaway MLP export,
+compile it, boot one server cold-JIT and one from the artifacts, and
+assert the artifact boot (a) answered from loaded executables only and
+(b) produced byte-identical /predict output.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+from paddle_tpu.aot.artifact import ArtifactWriter
+from paddle_tpu.aot.export import export_generator, export_model
+
+
+def _parse(argv):
+    args, rest = {}, []
+    for a in argv:
+        if a.startswith("--") and "=" in a:
+            k, v = a[2:].split("=", 1)
+            args[k] = v
+        else:
+            rest.append(a)
+    return args, rest
+
+
+def main(argv) -> int:
+    args, rest = _parse(argv)
+    if "--smoke" in rest:
+        return smoke()
+    model_dir = args.get("model_dir")
+    out = args.get("out")
+    if not out or (not model_dir and not args.get("gen_config")):
+        print("usage: paddle compile --model_dir=DIR --out=DIR "
+              "[--max_batch=N] [--buckets=1,2,...] [--no-optimize] "
+              "[--gen_config=SCRIPT ...] | paddle compile --smoke",
+              file=sys.stderr)
+        return 2
+    buckets = None
+    if args.get("buckets"):
+        buckets = [int(b) for b in args["buckets"].split(",") if b]
+    writer = ArtifactWriter(out)
+    if model_dir:
+        export_model(model_dir, out,
+                     max_batch=int(args.get("max_batch", 8)),
+                     buckets=buckets,
+                     optimize="--no-optimize" not in rest,
+                     writer=writer, finish=False)
+    if args.get("gen_config"):
+        from paddle_tpu.cli import _load_generator
+
+        gen = _load_generator(args, rest)
+        try:
+            export_generator(gen, out, writer=writer, finish=False)
+        finally:
+            gen.stop()
+    manifest = writer.finish(
+        extra={"model_dir": model_dir} if model_dir else None)
+    total = sum(e["nbytes"] for e in writer.entries.values())
+    print(f"exported {len(writer.entries)} executable(s), "
+          f"{total} bytes -> {manifest}")
+    for e in sorted(writer.entries.values(), key=lambda e: e["id"]):
+        print(f"  {e['id']}  fp={e['program_fp'][:12]}  "
+              f"sig={e['feed_sig']}  {e['nbytes']}B")
+    return 0
+
+
+def smoke() -> int:
+    """Export -> artifact-booted serve -> one request -> parity vs JIT.
+
+    Exercised by scripts/lint_self.sh; everything runs in-process
+    against throwaway temp dirs so the gate needs no fixtures."""
+    import tempfile
+    import urllib.request
+
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.serving import InferenceServer
+
+    def _predict(srv, body):
+        req = urllib.request.Request(
+            f"http://{srv.address}/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.read()
+
+    with tempfile.TemporaryDirectory(prefix="paddle_aot_smoke_") as tmp:
+        model_dir = os.path.join(tmp, "model")
+        art_dir = os.path.join(tmp, "artifacts")
+        fluid.framework.reset_default_programs()
+        x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        pred = fluid.layers.fc(input=h, size=4, act="softmax")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        fluid.io.save_inference_model(model_dir, ["x"], [pred], exe)
+
+        writer = export_model(model_dir, art_dir, max_batch=4)
+        print(f"smoke: exported {len(writer.entries)} executables")
+        body = json.dumps(
+            {"x": np.linspace(-1.0, 1.0, 18).reshape(3, 6).tolist()}
+        ).encode()
+
+        jit_srv = InferenceServer(model_dir, max_batch=4, warmup=True)
+        try:
+            jit_bytes = _predict(jit_srv, body)
+        finally:
+            jit_srv.stop()
+
+        aot_srv = InferenceServer(model_dir, max_batch=4, warmup=True,
+                                  artifacts=art_dir)
+        try:
+            aot_bytes = _predict(aot_srv, body)
+            results = dict(aot_srv._artifact_store.results)
+            boot = aot_srv._pool.boot_source()
+        finally:
+            aot_srv.stop()
+
+    if aot_bytes != jit_bytes:
+        print("smoke FAIL: artifact-booted /predict output differs from "
+              f"JIT ({aot_bytes!r} != {jit_bytes!r})", file=sys.stderr)
+        return 1
+    if boot != "aot" or not results.get("loaded"):
+        print(f"smoke FAIL: expected a pure artifact boot, got "
+              f"boot={boot!r} store results={results}", file=sys.stderr)
+        return 1
+    rejected = {k: v for k, v in results.items() if k != "loaded"}
+    if rejected:
+        print(f"smoke FAIL: artifact lookups rejected: {rejected}",
+              file=sys.stderr)
+        return 1
+    print(f"smoke OK: boot={boot} store={results} parity=bit-identical")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,88 @@
+"""Export drivers: run the serving warmup paths under capture.
+
+Export is *capture-mode* compilation: an ``ArtifactWriter`` is made the
+process-active exporter, then the exact code paths a serving boot runs
+(the replica bucket ladder; optionally one synthetic generation through
+the decode engine) are driven with zero-filled feeds.  Every
+Executor.run compile miss inside the capture window lowers its jitted
+step AOT (``fn.lower(...).compile()``), serializes the executable, and
+records a manifest entry — so what lands in the artifact directory is
+by construction exactly the set of programs a ``--warmup`` boot needs,
+already optimized (ModelBundle applies the rewrite pipeline before any
+replica compiles, and the OPTIMIZED fingerprint keys the entry).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from paddle_tpu.aot.artifact import ArtifactWriter
+
+
+def export_model(model_dir: str, out_dir: str, *,
+                 max_batch: int = 8,
+                 buckets: Optional[Sequence[int]] = None,
+                 optimize: bool = True,
+                 place=None,
+                 writer: Optional[ArtifactWriter] = None,
+                 finish: bool = True) -> ArtifactWriter:
+    """Export the bucket ladder of a save_inference_model directory.
+
+    Mirrors ``ReplicaPool.warmup()``: one replica (own Scope + Executor)
+    runs a zero-filled synthetic batch per bucket, each compile captured
+    into ``writer``.  Returns the writer; with ``finish`` (default) the
+    manifest is written too."""
+    import numpy as np
+
+    from paddle_tpu import aot as _aot
+    from paddle_tpu.serving.batching import bucket_ladder
+    from paddle_tpu.serving.replica import ModelBundle, Replica
+
+    bundle = ModelBundle(model_dir, optimize=optimize)
+    spec = bundle.batch_spec()
+    if not spec.batchable:
+        raise RuntimeError(
+            f"cannot export {model_dir}: the model is not batch-major "
+            f"({spec.reason}) so there is no static bucket ladder to "
+            "compile ahead of time")
+    rep = Replica(bundle, 0, place)
+    writer = writer or ArtifactWriter(out_dir)
+    buckets = tuple(buckets or bucket_ladder(max_batch))
+    with _aot.capture(writer):
+        for b in buckets:
+            feeds = {
+                name: np.zeros((b,) + spec.row_shapes[name],
+                               dtype=spec.dtypes[name])
+                for name in spec.feed_names
+            }
+            rep.run(feeds)
+    if finish:
+        writer.finish(extra={"model_dir": model_dir,
+                             "buckets": list(buckets)})
+    return writer
+
+
+def export_generator(generator, out_dir: str, *,
+                     prompt_ids: Optional[Sequence[int]] = None,
+                     max_new_tokens: int = 2,
+                     writer: Optional[ArtifactWriter] = None,
+                     finish: bool = True) -> ArtifactWriter:
+    """Export the decode-step programs of a GenerationEngine by running
+    one short synthetic generation under capture.
+
+    Covers every program the engine routes through an Executor (the
+    paged seq2seq prefill/decode steps); models that jit directly (the
+    tiny decoder LM demo) compile nothing through the executor and so
+    export nothing — they were never part of the cold-start cost this
+    subsystem removes."""
+    from paddle_tpu import aot as _aot
+
+    writer = writer or ArtifactWriter(out_dir)
+    ids = list(prompt_ids) if prompt_ids else [
+        int(getattr(generator.model, "bos_id", 1) or 1)]
+    with _aot.capture(writer):
+        req = generator.submit(ids, max_new_tokens=max_new_tokens)
+        req.result(timeout=600)
+    if finish:
+        writer.finish(extra={"generator": True})
+    return writer

@@ -11,8 +11,16 @@ Subcommands:
        binary for the C API; here: re-parse the v1 config, load the
        pass params, export a save_inference_model directory that
        capi/paddle_tpu_capi.h consumes)
+  paddle compile --model_dir=DIR --out=DIR [--max_batch=N]
+                 [--buckets=1,2,4] [--no-optimize] [--gen_config=SCRIPT]
+                 [--smoke]
+      (AOT serving artifacts — paddle_tpu/aot: run the serving warmup
+       paths under export capture and serialize every bucket-ladder /
+       decode-step executable into a versioned artifact directory that
+       `paddle serve --artifacts=DIR` boots from without JIT compiling;
+       --smoke is the self-contained export->boot->parity CI gate)
   paddle serve [--model_dir=DIR] [--port=N] [--replicas=N] [--max_batch=N]
-               [--batch_timeout_ms=MS] [--warmup]
+               [--batch_timeout_ms=MS] [--warmup] [--artifacts=DIR]
                [--request_timeout=SECONDS] [--max_inflight=N]
                [--gen_config=SCRIPT] [--gen_pages=N] [--gen_page_size=N]
                [--gen_pages_per_seq=N] [--gen_slots=N] [--gen_queue=N]
@@ -208,7 +216,7 @@ def _load_generator(args, flags=()):
 def cmd_serve(argv):
     """paddle serve [--model_dir=DIR] [--port=N] [--replicas=N]
     [--max_batch=N] [--batch_timeout_ms=MS] [--warmup]
-    [--request_timeout=S] [--max_inflight=N]
+    [--artifacts=DIR] [--request_timeout=S] [--max_inflight=N]
     [--tenants=NAME:RATE[:BURST[:WEIGHT]],...] [--tenant_config=FILE]
     [--max_attempts=N] [--replica_heartbeat_ms=MS]
     [--dispatch_timeout=S] [--chaos=KIND[@N[:rIDX]]]
@@ -222,7 +230,11 @@ def cmd_serve(argv):
     replicas, with graceful-degradation bounds (504 on deadline expiry,
     503 on overload).  Replicas are supervised and self-healing:
     crashed or hung dispatches requeue their batch (up to
-    --max_attempts per request) onto a respawned replica.  --tenants
+    --max_attempts per request) onto a respawned replica.
+    --artifacts=DIR boots replicas from a `paddle compile` export:
+    warmup deserializes the bucket ladder instead of JIT-compiling it
+    (manifest mismatches fall back to JIT loudly — see
+    aot_load_total{result} on /metrics).  --tenants
     gives each named tenant a token-bucket admission quota and a
     fair-queue weight ('*' entry templates unknown tenants;
     --tenant_config reads the same spec, one entry per line, from a
@@ -268,9 +280,24 @@ def cmd_serve(argv):
             dispatch_timeout=(float(a["dispatch_timeout"])
                               if a.get("dispatch_timeout") else None),
             chaos=a.get("chaos"),
+            artifacts=a.get("artifacts"),
             generator=(_load_generator(a, rest) if a.get("gen_config")
                        else None)),
         argv, "inference server")
+
+
+def cmd_compile(argv):
+    """paddle compile --model_dir=DIR --out=DIR [--max_batch=N]
+    [--buckets=1,2,4] [--no-optimize] [--gen_config=SCRIPT ...]
+    [--smoke] — export AOT serving artifacts (paddle_tpu/aot): the
+    bucket-ladder (and decode-step) executables a `paddle serve
+    --warmup` boot would JIT-compile, serialized under a versioned
+    manifest so `paddle serve --artifacts=DIR` boots without
+    compiling.  --smoke runs the self-contained export->boot->parity
+    gate CI uses."""
+    from paddle_tpu.aot.compile_cli import main as compile_main
+
+    return compile_main(argv)
 
 
 def cmd_elastic(argv):
@@ -538,6 +565,7 @@ COMMANDS = {
     "train": cmd_train,
     "version": cmd_version,
     "merge_model": cmd_merge_model,
+    "compile": cmd_compile,
     "serve": cmd_serve,
     "lint": cmd_lint,
     "stats": cmd_stats,

@@ -14,6 +14,18 @@ state as arguments and returns the written entries.  Buffers the
 donation-safety analyzer (paddle_tpu/analysis/optimize.py) proves dead
 after their last write are donated, so parameter updates alias in HBM
 with no host round-trip; everything else is held undonated.
+
+AOT artifacts (paddle_tpu/aot): on a compile-cache miss the executor
+first consults the attached artifact store (per-instance ``aot_store``
+or the process-global ``aot.attach``-ed one); a manifest match
+deserializes a ``paddle compile``-exported executable instead of
+tracing + compiling.  Donation is RESTORED on that path — the
+serialized executable carries its input-output aliasing and the
+manifest's donation mask is re-proved against the live analyzer before
+load — unlike the jax persistent compile cache, under which
+``_donation_ok()`` must disable donation entirely (cache-deserialized
+executables corrupt aliasing on this jaxlib).  Any mismatch is a loud
+JIT fallback counted in ``aot_load_total{result}``.
 """
 
 from __future__ import annotations
@@ -46,10 +58,13 @@ from paddle_tpu.sparse import SparseGrad
 
 _M_CACHE_MISS = _metrics.counter(
     "executor_compile_cache_miss_total",
-    "Executor.run compile-cache misses (program verified, traced, compiled)")
+    "Executor.run compile-cache misses, by how the executable was "
+    "produced (source=jit: verified, traced, compiled; source=aot: "
+    "deserialized from an artifact store)")
 _M_CACHE_HIT = _metrics.counter(
     "executor_compile_cache_hit_total",
-    "Executor.run compile-cache hits (cached XLA executable reused)")
+    "Executor.run compile-cache hits (cached XLA executable reused), "
+    "labeled by the executable's original source (jit|aot)")
 _M_COMPILE_SEC = _metrics.histogram(
     "executor_compile_seconds",
     "wall time per compile-cache miss: verify + build + jax trace/jit + "
@@ -233,14 +248,22 @@ def _feed_signature(feed_vals: Dict[str, Any]):
 
 
 class _Compiled:
-    __slots__ = ("fn", "state_names", "written_names", "fetch_names", "uses_rng")
+    __slots__ = ("fn", "state_names", "written_names", "fetch_names",
+                 "uses_rng", "donated_names", "held_names",
+                 "out_state_names", "source")
 
-    def __init__(self, fn, state_names, written_names, fetch_names, uses_rng):
+    def __init__(self, fn, state_names, written_names, fetch_names, uses_rng,
+                 donated_names=(), held_names=(), out_state_names=(),
+                 source="jit"):
         self.fn = fn
         self.state_names = state_names
         self.written_names = written_names
         self.fetch_names = fetch_names
         self.uses_rng = uses_rng
+        self.donated_names = donated_names
+        self.held_names = held_names
+        self.out_state_names = out_state_names
+        self.source = source
 
 
 def _segment_op_rng(seg_key, op):
@@ -274,6 +297,12 @@ class Executor:
         self._cache: Dict[Any, _Compiled] = {}
         self._opt_cache: Dict[Any, Any] = {}  # key -> (program, OptReport)
         self._step = 0
+        # artifact store consulted at compile misses (paddle_tpu/aot);
+        # None -> fall through to the process-global attached store
+        self.aot_store = None
+        # per-instance boot accounting: how each cache miss was filled
+        # (serving uses this to label a replica's boot jit/aot/mixed)
+        self.compile_counts = {"jit": 0, "aot": 0}
 
     # -- public api ---------------------------------------------------------
 
@@ -329,19 +358,29 @@ class Executor:
         cache_hit = compiled is not None
         t_compile = time.perf_counter()
         if compiled is None:
+            # compile miss: the artifact store (paddle_tpu/aot) gets
+            # first refusal — a manifest match deserializes the exported
+            # executable (donation intact) instead of trace+compile
+            compiled = self._aot_lookup(program, fp, feed_vals, fetch_names)
+        if compiled is not None and not cache_hit:
+            _M_CACHE_MISS.inc(program=prog_label, source="aot")
+            self.compile_counts["aot"] += 1
+            self._cache[key] = compiled
+        elif compiled is None:
             # Pre-compile static checks (paddle_tpu/analysis).  The fetch
             # check always runs — fetching a never-written variable must
             # name the variable up front, not die as a KeyError mid-trace.
             # With the check_program flag on, the full error tier runs
             # (def-before-use, dtype clash, bad sub-blocks, ...) before
             # any JAX tracing.  Cache hits skip both: already vetted.
-            _M_CACHE_MISS.inc(program=prog_label)
+            _M_CACHE_MISS.inc(program=prog_label, source="jit")
+            self.compile_counts["jit"] += 1
             with _EVENTS.span("executor.compile", program=prog_label):
                 self._verify(program, feed_vals, fetch_names)
                 compiled = self._compile(program, feed_vals, fetch_names, scope)
             self._cache[key] = compiled
         else:
-            _M_CACHE_HIT.inc(program=prog_label)
+            _M_CACHE_HIT.inc(program=prog_label, source=compiled.source)
 
         state = {}
         missing = []
@@ -355,6 +394,17 @@ class Executor:
                 f"persistable variables not initialized in scope: {missing}; "
                 "run the startup program first"
             )
+
+        if not cache_hit and compiled.source == "jit":
+            # export capture (aot.capture): lower this step AOT with the
+            # concrete args, serialize it into the active writer, and run
+            # the captured executable itself so the export is validated
+            # by execution
+            exported = self._aot_export(program, fp, compiled, state,
+                                        feed_vals)
+            if exported is not None:
+                compiled = exported
+                self._cache[key] = compiled
 
         self._step += 1
         args = [state, feed_vals]
@@ -467,6 +517,140 @@ class Executor:
         fp = program.fingerprint()
         program._fp_cache = (counts, fp)
         return fp
+
+    # -- AOT artifacts (paddle_tpu/aot) -------------------------------------
+
+    def _aot_active_store(self):
+        """The artifact store this executor should consult: its own
+        ``aot_store`` first, else the process-global attached one.  The
+        sys.modules probe keeps the hot path import-free: if nothing
+        ever imported paddle_tpu.aot, no store can be attached."""
+        if self.aot_store is not None:
+            return self.aot_store
+        import sys as _sys
+
+        mod = _sys.modules.get("paddle_tpu.aot")
+        return mod.active_store() if mod is not None else None
+
+    def _current_donated(self, program, feed_vals, fetch_names,
+                         state_names) -> tuple:
+        """The donation mask _compile would prove right now — the AOT
+        load side re-derives it and refuses an entry on drift (the
+        serialized executable's aliasing is baked in)."""
+        if not state_names or not _donation_ok():
+            return ()
+        from paddle_tpu.analysis import optimize as _opt
+
+        try:
+            donation = _opt.donation_mask(
+                program, set(feed_vals), fetch_names)
+        except Exception:
+            return ()
+        return tuple(n for n in state_names
+                     if n in donation and donation[n].eligible)
+
+    def _aot_lookup(self, program, fp, feed_vals, fetch_names):
+        """Consult the artifact store for this cache miss; returns a
+        ready _Compiled (source="aot") or None for the JIT path."""
+        if self.strategy is not None:
+            return None  # sharded steps are not exported
+        store = self._aot_active_store()
+        if store is None:
+            return None
+        from paddle_tpu.aot import artifact as _art
+
+        sig = _art.sig_json(_feed_signature(feed_vals))
+
+        def _validate(meta):
+            expect = tuple(meta.get("donated_names", ()))
+            have = self._current_donated(program, feed_vals, fetch_names,
+                                         tuple(meta["state_names"]))
+            if expect != have:
+                return (f"donation_drift: manifest donates {expect}, "
+                        f"live analysis proves {have}")
+            return None
+
+        hit = store.lookup(fp, sig, fetch_names, validate=_validate)
+        if hit is None:
+            return None
+        meta, loaded = hit
+        return self._wrap_aot(loaded, meta)
+
+    def _wrap_aot(self, executable, meta: dict) -> _Compiled:
+        """Adapt a (deserialized or freshly lowered) jax.stages.Compiled
+        to the _Compiled calling convention fn(state, feeds[, seed]).
+
+        Donation hygiene: unlike jax.jit, a raw Compiled call donates
+        whatever buffer it is handed — including one zero-copied from a
+        host numpy array (jnp.asarray aliases aligned host memory on
+        CPU), whose in-place overwrite would corrupt the caller's array.
+        So a donated input is defensively copied UNLESS it is this
+        executable's own previous output (an XLA-owned buffer): the
+        first step per state entry pays one copy, every steady-state
+        step donates for free."""
+        donated = tuple(meta["donated_names"])
+        held = tuple(meta["held_names"])
+        last_out: Dict[str, Any] = {}
+
+        def fn(state, feeds, *rest):
+            dvals = {}
+            for n in donated:
+                v = state[n]
+                if last_out.get(n) is not v:
+                    v = jnp.array(v, copy=True)
+                dvals[n] = v
+            fetches, new_state = executable(
+                dvals, {n: state[n] for n in held}, feeds, *rest)
+            for n in donated:
+                if n in new_state:
+                    last_out[n] = new_state[n]
+            return fetches, new_state
+
+        return _Compiled(fn, tuple(meta["state_names"]),
+                         tuple(meta["written_names"]),
+                         tuple(meta["fetch_names"]),
+                         bool(meta["uses_rng"]),
+                         donated_names=donated, held_names=held,
+                         out_state_names=tuple(meta["out_state_names"]),
+                         source="aot")
+
+    def _aot_export(self, program, fp, compiled: _Compiled, state,
+                    feed_vals) -> Optional[_Compiled]:
+        """When an aot.capture window is active, lower this fresh JIT
+        compile ahead-of-time, serialize it into the writer, and return
+        the captured executable wrapped for execution.  Any failure
+        (e.g. an unserializable program) leaves the JIT path untouched."""
+        if self.strategy is not None:
+            return None
+        import sys as _sys
+
+        mod = _sys.modules.get("paddle_tpu.aot")
+        writer = mod.active_exporter() if mod is not None else None
+        if writer is None:
+            return None
+        rest = (np.int64(self._seed_for_step(program)),) \
+            if compiled.uses_rng else ()
+        try:
+            executable = compiled.fn.lower(state, feed_vals, *rest).compile()
+            meta = writer.add(
+                program_fp=fp,
+                feed_sig=_feed_signature(feed_vals),
+                fetch_names=compiled.fetch_names,
+                executable=executable,
+                state_names=compiled.state_names,
+                donated_names=compiled.donated_names,
+                held_names=compiled.held_names,
+                out_state_names=compiled.out_state_names,
+                written_names=compiled.written_names,
+                uses_rng=compiled.uses_rng)
+        except Exception as exc:
+            import sys
+
+            print(f"[paddle_tpu.aot] export skipped for program "
+                  f"{fp[:12]}: {type(exc).__name__}: {exc}",
+                  file=sys.stderr)
+            return None
+        return self._wrap_aot(executable, meta)
 
     def build_callable(self, program: Program, feed_vals: Dict[str, Any],
                        fetch_names: Sequence[str], scope: Optional[Scope] = None):
@@ -672,7 +856,8 @@ class Executor:
 
         if not jit:
             return _Compiled(run_block, state_names, written_names, fetch_names,
-                             uses_rng)
+                             uses_rng, held_names=state_names,
+                             out_state_names=out_state_names)
 
         # The jitted step takes (donated_state, held_state, feeds[, seed])
         # so donate_argnums=(0,) donates exactly the buffers the mask
@@ -711,4 +896,6 @@ class Executor:
         # wrapper (tests/benchmarks call compiled.fn.lower(state, feeds))
         fn.lower = lambda state, feeds, *rest: jfn.lower(
             *_split(state), feeds, *rest)
-        return _Compiled(fn, state_names, written_names, fetch_names, uses_rng)
+        return _Compiled(fn, state_names, written_names, fetch_names, uses_rng,
+                         donated_names=donated_names, held_names=held_names,
+                         out_state_names=out_state_names)

@@ -19,8 +19,33 @@ from paddle_tpu.lod import rewrap, unwrap
 from paddle_tpu.registry import SkipInferShape, register_op
 
 
+def _infer_layer_norm_shape(op, block):
+    # Y mirrors X; Mean/Variance keep the leading (un-normalized) axes
+    xs = op.inputs.get("X", [])
+    ys = op.outputs.get("Y", [])
+    if len(xs) != 1 or len(ys) != 1 or not xs[0] or not ys[0]:
+        raise SkipInferShape
+    xv, yv = block.find_var(xs[0]), block.find_var(ys[0])
+    if xv is None or yv is None or xv.shape is None:
+        raise SkipInferShape
+    if yv.shape is None:
+        yv.shape = tuple(xv.shape)
+    if yv.lod_level == 0 and xv.lod_level:
+        yv.lod_level = xv.lod_level
+    begin = int(op.attr("begin_norm_axis", 1) or 1)
+    if not 0 < begin <= len(xv.shape):
+        raise SkipInferShape
+    for slot in ("Mean", "Variance"):
+        names = op.outputs.get(slot, [])
+        if len(names) == 1 and names[0]:
+            sv = block.find_var(names[0])
+            if sv is not None and sv.shape is None:
+                sv.shape = tuple(xv.shape[:begin])
+
+
 @register_op("layer_norm", inputs=("X", "Scale", "Bias"),
-             outputs=("Y", "Mean", "Variance"))
+             outputs=("Y", "Mean", "Variance"),
+             infer_shape=_infer_layer_norm_shape)
 def _layer_norm(ctx):
     x = unwrap(ctx.input("X"))
     eps = ctx.attr("epsilon", 1e-5)

@@ -20,7 +20,12 @@ import jax.numpy as jnp
 from jax import lax
 
 from paddle_tpu.lod import unwrap
-from paddle_tpu.registry import LowerContext, OpRegistry, register_op
+from paddle_tpu.registry import (
+    LowerContext,
+    OpRegistry,
+    SkipInferShape,
+    register_op,
+)
 from paddle_tpu.tensor_array import TensorArray
 
 
@@ -194,7 +199,22 @@ def _is_empty(ctx):
     ctx.set_output("Out", jnp.asarray(x.size == 0))
 
 
-@register_op("multiplex", inputs=("Ids", "X"), diff_inputs=("X",))
+def _infer_multiplex_shape(op, block):
+    # Out picks one row per index from the stacked candidates: it
+    # mirrors any single candidate's shape
+    xs = op.inputs.get("X", [])
+    outs = op.outputs.get("Out", [])
+    if not xs or not xs[0] or len(outs) != 1 or not outs[0]:
+        raise SkipInferShape
+    xv, ov = block.find_var(xs[0]), block.find_var(outs[0])
+    if xv is None or ov is None or xv.shape is None:
+        raise SkipInferShape
+    if ov.shape is None:
+        ov.shape = tuple(xv.shape)
+
+
+@register_op("multiplex", inputs=("Ids", "X"), diff_inputs=("X",),
+             infer_shape=_infer_multiplex_shape)
 def _multiplex(ctx):
     ids = unwrap(ctx.input("Ids")).astype(jnp.int32).reshape(-1)
     xs = jnp.stack([unwrap(v) for v in ctx.inputs("X")])  # (K, N, D)

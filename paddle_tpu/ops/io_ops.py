@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from jax.experimental import io_callback
 
 from paddle_tpu.lod import unwrap
-from paddle_tpu.registry import register_op
+from paddle_tpu.registry import SkipInferShape, register_op
 
 
 @register_op("feed", inputs=("X",), stop_gradient=True)
@@ -35,7 +35,20 @@ def _fetch(ctx):
     ctx.set_output("Out", ctx.input("X"))
 
 
-@register_op("fill", inputs=(), stop_gradient=True)
+def _infer_fill_shape(op, block):
+    outs = op.outputs.get("Out", [])
+    if len(outs) != 1 or not outs[0]:
+        raise SkipInferShape
+    ov = block.find_var(outs[0])
+    shape = op.attr("shape", None)
+    if ov is None or not shape:
+        raise SkipInferShape
+    if ov.shape is None:
+        ov.shape = tuple(int(s) for s in shape)
+
+
+@register_op("fill", inputs=(), stop_gradient=True,
+             infer_shape=_infer_fill_shape)
 def _fill(ctx):
     shape = tuple(int(s) for s in ctx.attr("shape", []))
     dtype = jnp.dtype(ctx.attr("dtype", "float32"))

@@ -245,12 +245,65 @@ def _clip_by_norm(ctx):
     unary(ctx, f)
 
 
-@register_op("squared_l2_norm", inputs=("X",))
+def _slot_var(op, block, slot, inputs=True, need_shape=False):
+    names = (op.inputs if inputs else op.outputs).get(slot, [])
+    if len(names) != 1 or not names[0]:
+        raise SkipInferShape
+    v = block.find_var(names[0])
+    if v is None or (need_shape and v.shape is None):
+        raise SkipInferShape
+    return v
+
+
+def _set_shape(v, shape):
+    if v.shape is None:
+        v.shape = tuple(int(s) for s in shape)
+
+
+def _infer_squared_l2_norm_shape(op, block):
+    _slot_var(op, block, "X", need_shape=True)
+    _set_shape(_slot_var(op, block, "Out", inputs=False), (1,))
+
+
+def _infer_squared_l2_distance_shape(op, block):
+    xv = _slot_var(op, block, "X", need_shape=True)
+    _set_shape(_slot_var(op, block, "sub_result", inputs=False), xv.shape)
+    _set_shape(_slot_var(op, block, "Out", inputs=False),
+               (xv.shape[0], 1))
+
+
+def _infer_cos_sim_shape(op, block):
+    # size-K form (Y holds K stacked vectors of X's width) yields K
+    # similarities per row; the plain form yields one
+    xv = _slot_var(op, block, "X", need_shape=True)
+    yv = _slot_var(op, block, "Y", need_shape=True)
+    if not xv.shape or not yv.shape or not xv.shape[-1]:
+        raise SkipInferShape
+    k = (1 if yv.shape[-1] == xv.shape[-1]
+         else yv.shape[-1] // xv.shape[-1])
+    _set_shape(_slot_var(op, block, "Out", inputs=False),
+               tuple(xv.shape[:-1]) + (k,))
+    _set_shape(_slot_var(op, block, "XNorm", inputs=False),
+               tuple(xv.shape[:-1]) + (1,))
+    _set_shape(_slot_var(op, block, "YNorm", inputs=False),
+               tuple(yv.shape[:-1]) + (k if k > 1 else 1,))
+
+
+def _infer_bilinear_shape(op, block):
+    xv = _slot_var(op, block, "X", need_shape=True)
+    wv = _slot_var(op, block, "Weight", need_shape=True)
+    _set_shape(_slot_var(op, block, "Out", inputs=False),
+               (xv.shape[0], wv.shape[0]))
+
+
+@register_op("squared_l2_norm", inputs=("X",),
+             infer_shape=_infer_squared_l2_norm_shape)
 def _squared_l2_norm(ctx):
     unary(ctx, lambda x: jnp.sum(jnp.square(x)).reshape(1))
 
 
-@register_op("squared_l2_distance", inputs=("X", "Y"), outputs=("sub_result", "Out"))
+@register_op("squared_l2_distance", inputs=("X", "Y"), outputs=("sub_result", "Out"),
+             infer_shape=_infer_squared_l2_distance_shape)
 def _squared_l2_distance(ctx):
     x = unwrap(ctx.input("X"))
     y = broadcast_to_x(x, ctx.input("Y"), 0)
@@ -259,7 +312,8 @@ def _squared_l2_distance(ctx):
     ctx.set_output("Out", jnp.sum(jnp.square(sub), axis=tuple(range(1, sub.ndim))).reshape(-1, 1))
 
 
-@register_op("cos_sim", inputs=("X", "Y"), outputs=("Out", "XNorm", "YNorm"))
+@register_op("cos_sim", inputs=("X", "Y"), outputs=("Out", "XNorm", "YNorm"),
+             infer_shape=_infer_cos_sim_shape)
 def _cos_sim(ctx):
     x = unwrap(ctx.input("X"))
     y = unwrap(ctx.input("Y"))
@@ -321,7 +375,8 @@ def _minus(ctx):
     ctx.set_output("Out", rewrap(x, unwrap(x) - unwrap(ctx.input("Y"))))
 
 
-@register_op("bilinear_tensor_product", inputs=("X", "Y", "Weight", "Bias"))
+@register_op("bilinear_tensor_product", inputs=("X", "Y", "Weight", "Bias"),
+             infer_shape=_infer_bilinear_shape)
 def _bilinear_tensor_product(ctx):
     x = unwrap(ctx.input("X"))  # (B, M)
     y = unwrap(ctx.input("Y"))  # (B, N)

@@ -37,8 +37,27 @@ def _top_k(ctx):
     ctx.set_output("Indices", idx.astype(jnp.int64))
 
 
+def _infer_accuracy_shape(op, block):
+    # Accuracy is the (1,) batch mean; Correct/Total are scalar counts
+    hit = False
+    for slot, shape in (("Accuracy", (1,)), ("Correct", ()),
+                        ("Total", ())):
+        names = op.outputs.get(slot, [])
+        if len(names) != 1 or not names[0]:
+            continue
+        v = block.find_var(names[0])
+        if v is None:
+            continue
+        hit = True
+        if v.shape is None:
+            v.shape = shape
+    if not hit:
+        raise SkipInferShape
+
+
 @register_op("accuracy", inputs=("Out", "Indices", "Label"),
-             outputs=("Accuracy", "Correct", "Total"), stop_gradient=True)
+             outputs=("Accuracy", "Correct", "Total"), stop_gradient=True,
+             infer_shape=_infer_accuracy_shape)
 def _accuracy(ctx):
     """Top-k accuracy given top_k's outputs (reference:
     operators/accuracy_op.cc)."""

@@ -18,7 +18,7 @@ from jax import lax
 
 from paddle_tpu.lod import LoDArray, rewrap, row_segment_ids, unwrap
 from paddle_tpu.ops.nn_ops import _make_pool_infer
-from paddle_tpu.registry import register_op
+from paddle_tpu.registry import SkipInferShape, infer_same_shape, register_op
 
 NEG_INF = -1e9
 
@@ -202,7 +202,8 @@ def _roi_pool(ctx):
         ctx.set_output("Argmax", jnp.zeros(out.shape, jnp.int32))
 
 
-@register_op("row_conv", inputs=("X", "Filter"), diff_inputs=("X", "Filter"))
+@register_op("row_conv", inputs=("X", "Filter"), diff_inputs=("X", "Filter"),
+             infer_shape=infer_same_shape)
 def _row_conv(ctx):
     """Lookahead row convolution (reference: operators/row_conv_op.cc):
     out[t] = sum_{i=0..k-1} w[i] * x[t+i], over (B, T, D) input."""
@@ -215,7 +216,8 @@ def _row_conv(ctx):
     ctx.set_output("Out", out)
 
 
-@register_op("conv_shift", inputs=("X", "Y"), diff_inputs=("X", "Y"))
+@register_op("conv_shift", inputs=("X", "Y"), diff_inputs=("X", "Y"),
+             infer_shape=infer_same_shape)
 def _conv_shift(ctx):
     """Circular correlation (reference: operators/conv_shift_op.cc):
     out[b, i] = sum_j x[b, (i + j - M/2) mod N] * y[b, j]."""
@@ -310,14 +312,29 @@ def _pool3d(ctx):
     ctx.set_output("Out", out)
 
 
-@register_op("sampling_id", inputs=("X",), stop_gradient=True)
+def _infer_sampling_id_shape(op, block):
+    # categorical over the last (class) axis: (B, C) probs -> (B,) ids
+    xs = op.inputs.get("X", [])
+    outs = op.outputs.get("Out", [])
+    if len(xs) != 1 or len(outs) != 1 or not xs[0] or not outs[0]:
+        raise SkipInferShape
+    xv, ov = block.find_var(xs[0]), block.find_var(outs[0])
+    if xv is None or ov is None or xv.shape is None:
+        raise SkipInferShape
+    if ov.shape is None:
+        ov.shape = tuple(xv.shape[:-1])
+
+
+@register_op("sampling_id", inputs=("X",), stop_gradient=True,
+             infer_shape=_infer_sampling_id_shape)
 def _sampling_id(ctx):
     probs = unwrap(ctx.input("X"))
     ctx.set_output("Out", jax.random.categorical(
         ctx.rng(), jnp.log(probs + 1e-12), axis=-1).astype(jnp.int64))
 
 
-@register_op("norm", inputs=("X", "Scale"), diff_inputs=("X", "Scale"))
+@register_op("norm", inputs=("X", "Scale"), diff_inputs=("X", "Scale"),
+             infer_shape=infer_same_shape)
 def _norm(ctx):
     """Cross-channel L2 norm + per-channel scale (reference:
     operators/norm_op.cc, the SSD NormLayer)."""

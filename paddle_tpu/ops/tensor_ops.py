@@ -167,7 +167,56 @@ def _infer_one_hot_shape(op, block):
         ov.shape = shape + (int(depth),)
 
 
-@register_op("fill_constant", inputs=(), stop_gradient=True)
+def _infer_attr_shape(op, block):
+    # source ops (no tensor inputs) whose static shape IS their "shape"
+    # attribute: fill_constant, uniform_random, gaussian_random, ...
+    outs = op.outputs.get("Out", [])
+    if len(outs) != 1:
+        raise SkipInferShape
+    ov = _shape_var(block, outs[0])
+    shape = op.attr("shape", None)
+    if not shape:
+        raise SkipInferShape
+    if ov.shape is None:
+        ov.shape = tuple(int(s) for s in shape)
+
+
+def _infer_fill_bsl_shape(op, block):
+    xv, ov = _one_in_out(op, block, in_slot="Input")
+    shape = list(op.attr("shape", None) or [])
+    in_idx = int(op.attr("input_dim_idx", 0) or 0)
+    out_idx = int(op.attr("output_dim_idx", 0) or 0)
+    if (not shape or in_idx >= len(xv.shape) or out_idx >= len(shape)):
+        raise SkipInferShape
+    shape[out_idx] = xv.shape[in_idx]
+    if ov.shape is None:
+        ov.shape = tuple(int(s) for s in shape)
+
+
+def _infer_lookup_table_shape(op, block):
+    # Ids (..., 1) int64 against W (V, D) -> Out (..., D); Out rides
+    # Ids' LoD (sequence embedding keeps the sequence structure)
+    ws = op.inputs.get("W", [])
+    ids = op.inputs.get("Ids", [])
+    outs = op.outputs.get("Out", [])
+    if len(ws) != 1 or len(ids) != 1 or len(outs) != 1:
+        raise SkipInferShape
+    wv = _shape_var(block, ws[0])
+    iv = _shape_var(block, ids[0])
+    ov = _shape_var(block, outs[0])
+    if wv.shape is None or iv.shape is None:
+        raise SkipInferShape
+    base = tuple(iv.shape)
+    if base and base[-1] == 1:
+        base = base[:-1]
+    if ov.shape is None:
+        ov.shape = base + (wv.shape[-1],)
+    if ov.lod_level == 0 and iv.lod_level:
+        ov.lod_level = iv.lod_level
+
+
+@register_op("fill_constant", inputs=(), stop_gradient=True,
+             infer_shape=_infer_attr_shape)
 def _fill_constant(ctx):
     shape = tuple(ctx.attr("shape", ()))
     dtype = jnp_dtype(ctx.attr("dtype", "float32"))
@@ -175,7 +224,8 @@ def _fill_constant(ctx):
     ctx.set_output("Out", jnp.full(shape, value, dtype=dtype))
 
 
-@register_op("fill_constant_batch_size_like", inputs=("Input",), stop_gradient=True)
+@register_op("fill_constant_batch_size_like", inputs=("Input",), stop_gradient=True,
+             infer_shape=_infer_fill_bsl_shape)
 def _fill_constant_bsl(ctx):
     ref = unwrap(ctx.input("Input"))
     shape = list(ctx.attr("shape"))
@@ -202,7 +252,8 @@ def _cast(ctx):
     unary(ctx, lambda x: x.astype(dtype))
 
 
-@register_op("uniform_random", inputs=(), stop_gradient=True)
+@register_op("uniform_random", inputs=(), stop_gradient=True,
+             infer_shape=_infer_attr_shape)
 def _uniform_random(ctx):
     shape = tuple(ctx.attr("shape"))
     dtype = jnp_dtype(ctx.attr("dtype", "float32"))
@@ -212,7 +263,8 @@ def _uniform_random(ctx):
     ctx.set_output("Out", jax.random.uniform(key, shape, dtype=jnp.float32, minval=lo, maxval=hi).astype(dtype))
 
 
-@register_op("gaussian_random", inputs=(), stop_gradient=True)
+@register_op("gaussian_random", inputs=(), stop_gradient=True,
+             infer_shape=_infer_attr_shape)
 def _gaussian_random(ctx):
     shape = tuple(ctx.attr("shape"))
     dtype = jnp_dtype(ctx.attr("dtype", "float32"))
@@ -326,7 +378,8 @@ def _lookup_table_grad_lower(ctx):
 
 
 @register_op("lookup_table", inputs=("W", "Ids"), diff_inputs=("W",),
-             grad_lower=_lookup_table_grad_lower)
+             grad_lower=_lookup_table_grad_lower,
+             infer_shape=_infer_lookup_table_shape)
 def _lookup_table(ctx):
     """Embedding lookup (reference: operators/lookup_table_op.cc).  Ids of
     shape (..., 1) int64; gradient w.r.t. W is a SelectedRows-style

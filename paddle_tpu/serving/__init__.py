@@ -84,9 +84,16 @@ Self-healing & multi-tenancy (PR 19):
     tenants first; ``/health`` flips to ``"degraded"`` with reasons
     while the pool is down replicas or shedding.
 
+Cold start (PR 20): ``--artifacts=DIR`` boots replicas from a
+``paddle compile`` export — every bucket-ladder program is
+deserialized from the artifact store instead of traced+compiled, with
+donation restored (see ``paddle_tpu/aot``).  Warmup wall time lands in
+``serving_time_to_ready_seconds{boot=aot|jit|mixed}``.
+
 Launch:  paddle serve --model_dir=DIR [--port=N]
                       [--replicas=N] [--max_batch=N]
                       [--batch_timeout_ms=MS] [--warmup]
+                      [--artifacts=DIR]
                       [--request_timeout=SECONDS] [--max_inflight=N]
                       [--tenants=SPEC] [--max_attempts=N]
                       [--replica_heartbeat_ms=MS] [--chaos=KIND@N]
@@ -164,7 +171,7 @@ class InferenceServer:
                  max_attempts: int = 3,
                  replica_heartbeat_ms: float = 1000.0,
                  dispatch_timeout: float = None, chaos=None,
-                 shed_watermark: int = None):
+                 shed_watermark: int = None, artifacts: str = None):
         if model_dir is None and generator is None:
             raise ValueError("need a model_dir to predict from and/or a "
                              "generator (paddle_tpu.decode."
@@ -193,6 +200,20 @@ class InferenceServer:
             shed_watermark = max(64, 8 * max_batch)
         self.fault = (FaultInjector.from_spec(chaos)
                       if isinstance(chaos, str) else chaos)
+        self._artifact_store = None
+        self._aot_attached = False
+        if artifacts:
+            # `paddle compile` output: replicas consult the store before
+            # tracing; any manifest mismatch is a loud JIT fallback
+            # (aot_load_total{result=rejected_*}), never a wrong answer
+            from paddle_tpu import aot as _aot
+
+            self._artifact_store = _aot.ArtifactStore(artifacts)
+            if generator is not None:
+                # the decode engine builds its executors deep inside the
+                # model — attach process-globally so they see the store
+                _aot.attach(self._artifact_store)
+                self._aot_attached = True
         self._queue = RequestQueue(max_batch=max_batch,
                                    batch_timeout=batch_timeout_ms / 1000.0,
                                    tenants=self._tenants,
@@ -202,7 +223,8 @@ class InferenceServer:
                                   fault=self.fault,
                                   max_attempts=max_attempts,
                                   heartbeat=replica_heartbeat_ms / 1000.0,
-                                  dispatch_timeout=dispatch_timeout)
+                                  dispatch_timeout=dispatch_timeout,
+                                  artifact_store=self._artifact_store)
                       if self._bundle else None)
         self._request_timeout = request_timeout
         self._max_inflight = max_inflight
@@ -253,6 +275,7 @@ class InferenceServer:
                         "fetches": [getattr(f, "name", str(f))
                                     for f in server._fetches],
                         "batching": server.batching_info(),
+                        "aot": server.aot_info(),
                         "generation": (server._generator.info()
                                        if server._generator else None)})
                 elif self.path == "/metrics":
@@ -538,6 +561,16 @@ class InferenceServer:
             "queue": self._queue.degradation(),
         }
 
+    def aot_info(self) -> Optional[dict]:
+        """Artifact-store state for /health: root, poison reason, entry
+        count, per-outcome lookup results, and the pool's boot source."""
+        if self._artifact_store is None:
+            return None
+        info = self._artifact_store.info()
+        info["boot"] = (self._pool.boot_source()
+                        if self._pool is not None else None)
+        return info
+
     def batching_info(self) -> dict:
         return {
             "enabled": self._spec.batchable,
@@ -631,4 +664,9 @@ class InferenceServer:
             self._pool.stop()
         if self._generator is not None:
             self._generator.stop()
+        if self._aot_attached:
+            from paddle_tpu import aot as _aot
+
+            _aot.detach()
+            self._aot_attached = False
         self._httpd.server_close()

@@ -77,6 +77,13 @@ _M_REQUEUED = _metrics.counter(
     "in-flight requests requeued after losing their replica")
 _M_LIVE = _metrics.gauge(
     "serving_replicas_live", "replicas currently taking batches")
+_M_TTR = _metrics.histogram(
+    "serving_time_to_ready_seconds",
+    "warmup() wall time until every replica's bucket ladder is "
+    "compiled, labeled by boot source (aot = every program loaded "
+    "from the artifact store, jit = every program traced+compiled, "
+    "mixed = partial artifact coverage)",
+    buckets=_metrics.COMPILE_TIME_BUCKETS)
 
 #: Errors attributed to the *request* (malformed feed dict, bad dtype,
 #: shape mismatch at scatter): fail the waiters, keep the replica.  An
@@ -225,7 +232,7 @@ class Replica:
     """One worker clone: private Scope + private Executor."""
 
     def __init__(self, bundle: ModelBundle, index: int, place=None,
-                 fault: Optional[FaultInjector] = None):
+                 fault: Optional[FaultInjector] = None, store=None):
         import paddle_tpu as fluid
         from paddle_tpu import executor as executor_mod
 
@@ -236,6 +243,9 @@ class Replica:
         bundle.load_params_into(self.scope)
         self.exe = fluid.Executor(place if place is not None
                                   else fluid.TPUPlace())
+        # artifact-booted replica: the executor consults this store at
+        # every compile-cache miss before tracing (paddle_tpu/aot)
+        self.exe.aot_store = store
 
     def run(self, feeds) -> list:
         if self.fault is not None:
@@ -257,12 +267,13 @@ class ReplicaPool:
                  dispatch_timeout: Optional[float] = None,
                  respawn_policy: RetryPolicy = RESPAWN_POLICY,
                  max_restarts: int = 8, restart_window: float = 60.0,
-                 supervise: bool = True):
+                 supervise: bool = True, artifact_store=None):
         self.bundle = bundle
         self.queue = queue
         self.spec = spec
         self._place = place
         self.fault = fault
+        self.artifact_store = artifact_store
         self.configured = max(1, int(replicas))
         self.max_attempts = max(1, int(max_attempts))
         self.heartbeat = max(0.01, float(heartbeat))
@@ -291,7 +302,8 @@ class ReplicaPool:
         self._budget_exhausted = False
 
         for _ in range(self.configured):
-            rep = Replica(bundle, self._next_index, place, fault=fault)
+            rep = Replica(bundle, self._next_index, place, fault=fault,
+                          store=artifact_store)
             self._next_index += 1
             self._spawn_worker(rep)
         _M_LIVE.set(len(self._live))
@@ -364,11 +376,17 @@ class ReplicaPool:
     def warmup(self, buckets: Optional[Sequence[int]] = None) -> int:
         """Pre-compile the bucket ladder on every replica with synthetic
         batches (zeros), so live traffic starts at cache-hit steady
-        state.  Returns the number of (replica, bucket) programs run."""
+        state.  Returns the number of (replica, bucket) programs run.
+
+        The wall time lands in ``serving_time_to_ready_seconds{boot=}``:
+        ``aot`` when every program came out of the artifact store,
+        ``jit`` when every one was traced+compiled, ``mixed`` for
+        partial coverage — the before/after of ``paddle compile``."""
         if not self.spec.batchable:
             return 0
         buckets = tuple(buckets or bucket_ladder(self.queue.max_batch))
         reps = self.replicas
+        t0 = time.monotonic()
 
         def _one(rep):
             for b in buckets:
@@ -385,7 +403,22 @@ class ReplicaPool:
             t.start()
         for t in threads:
             t.join()
+        _M_TTR.observe(time.monotonic() - t0, boot=self.boot_source())
         return len(buckets) * len(reps)
+
+    def boot_source(self) -> str:
+        """``aot`` / ``jit`` / ``mixed``: where the live replicas'
+        compiled programs came from (their executors' compile counts)."""
+        jit = aot = 0
+        for rep in self.replicas:
+            counts = getattr(rep.exe, "compile_counts", None) or {}
+            jit += counts.get("jit", 0)
+            aot += counts.get("aot", 0)
+        if aot and not jit:
+            return "aot"
+        if jit and not aot:
+            return "jit"
+        return "mixed" if (jit and aot) else "jit"
 
     # -- worker loop --------------------------------------------------------
 
@@ -542,7 +575,8 @@ class ReplicaPool:
             self._next_index += 1
             self._restarts.append(now)
         try:
-            rep = Replica(self.bundle, index, self._place, fault=self.fault)
+            rep = Replica(self.bundle, index, self._place, fault=self.fault,
+                          store=self.artifact_store)
         except Exception:
             # params/device unavailable right now: put the slot back and
             # retry next sweep with more backoff

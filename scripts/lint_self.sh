@@ -35,7 +35,7 @@ python - <<'EOF'
 import json
 doc = json.load(open("paddle_tpu/analysis/registry_baseline.json"))
 total = sum(len(v) for v in doc.values())
-LIMIT = 96  # ratchet: only lower this, never raise it
+LIMIT = 80  # ratchet: only lower this, never raise it
 assert total <= LIMIT, (
     f"registry baseline gap {total} > {LIMIT}: new/changed ops must "
     "ship infer_shape rules and input slots instead of growing the "
@@ -48,17 +48,22 @@ echo "== paddle stats: telemetry registry smoke"
 $PADDLE stats --json > /dev/null
 $PADDLE stats > /dev/null
 
-echo "== ruff: analysis + observability + distributed fault-tolerance + serving + decode + tuning"
+echo "== ruff: analysis + observability + distributed fault-tolerance + serving + decode + tuning + aot"
 if command -v ruff >/dev/null 2>&1; then
     ruff check paddle_tpu/analysis/ paddle_tpu/observability/ \
         paddle_tpu/distributed/elastic.py paddle_tpu/distributed/retry.py \
         paddle_tpu/serving/ paddle_tpu/decode/ \
-        paddle_tpu/pallas/tuning/ \
+        paddle_tpu/pallas/tuning/ paddle_tpu/aot/ \
         benchmark/serving_bench.py benchmark/decode_bench.py \
-        benchmark/serving_chaos_bench.py
+        benchmark/serving_chaos_bench.py benchmark/coldstart_bench.py
 else
     echo "ruff not installed; skipping style pass"
 fi
+
+echo "== paddle compile: AOT artifact round trip (export -> boot -> parity)"
+# exports a throwaway MLP, boots one server cold-JIT and one from the
+# artifacts, and asserts a pure aot boot with byte-identical /predict
+$PADDLE compile --smoke
 
 echo "== serving_bench: smoke (batching engine + artifact writer)"
 python benchmark/serving_bench.py --smoke --out /tmp/serving_bench_smoke.json \

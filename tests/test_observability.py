@@ -218,8 +218,8 @@ def test_executor_cache_miss_then_hit_counters():
 
     miss = by_label("executor_compile_cache_miss_total")
     hit = by_label("executor_compile_cache_hit_total")
-    assert miss[(("program", label),)]["value"] == 1
-    assert hit[(("program", label),)]["value"] == 1
+    assert miss[(("program", label), ("source", "jit"))]["value"] == 1
+    assert hit[(("program", label), ("source", "jit"))]["value"] == 1
 
     # per-fingerprint compile + step + feed metrics rode along
     compile_sec = by_label("executor_compile_seconds")
@@ -261,8 +261,9 @@ def test_trace_ops_flag_is_part_of_cache_key():
                                rtol=1e-6)
     miss = obs.REGISTRY.get("executor_compile_cache_miss_total")
     hit = obs.REGISTRY.get("executor_compile_cache_hit_total")
-    assert miss.value(program=label) == 2  # plain + traced variants
-    assert hit.value(program=label) == 1   # second traced run cached
+    # plain + traced variants, both fresh JIT compiles
+    assert miss.value(program=label, source="jit") == 2
+    assert hit.value(program=label, source="jit") == 1  # traced rerun cached
 
 
 def test_step_overhead_within_budget():

@@ -219,7 +219,7 @@ def test_warmup_precompiles_buckets_traffic_all_cache_hits(tmp_path):
     try:
         misses = obs.REGISTRY.get("executor_compile_cache_miss_total")
         fp = srv._bundle.program.fingerprint()[:12]
-        after_warmup = misses.value(program=fp)
+        after_warmup = misses.value(program=fp, source="jit")
         assert after_warmup == 2 * len(bucket_ladder(4))  # replicas x ladder
 
         rng = np.random.RandomState(0)
@@ -234,7 +234,7 @@ def test_warmup_precompiles_buckets_traffic_all_cache_hits(tmp_path):
         srv.resume()
         for t in threads:
             t.join(timeout=60)
-        assert misses.value(program=fp) == after_warmup  # hit rate 1.0
+        assert misses.value(program=fp, source="jit") == after_warmup  # hit rate 1.0
     finally:
         srv.stop()
 
